@@ -1,0 +1,63 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the MXNet API surface.
+
+A from-scratch rebuild of the capabilities of apache/incubator-mxnet
+(reference: Mooonside/incubator-mxnet) designed TPU-first on jax/XLA/Pallas:
+
+- ``NDArray`` keeps MXNet's asynchronous, mutable array semantics
+  (reference: include/mxnet/ndarray.h, src/ndarray/ndarray.cc) but is backed
+  by immutable ``jax.Array`` buffers — mutation is handle-swapping with a
+  version counter; "async engine" scheduling (reference: src/engine/) is
+  delegated to XLA/PJRT's already-asynchronous dispatch, with
+  ``wait_to_read()`` mapping to ``block_until_ready()``.
+- The operator library (reference: src/operator/) is a registry of pure JAX
+  functions; the ``mx.nd.*`` / ``mx.np``-style wrappers are generated from the
+  registry at import time, mirroring python/mxnet/ndarray/register.py.
+- ``gluon`` keeps Block/HybridBlock/Parameter/Trainer semantics; ``hybridize()``
+  compiles the whole step with ``jax.jit`` (the CachedOp analog,
+  reference: src/imperative/cached_op.cc).
+- ``kvstore`` maps push/pull onto XLA collectives over the ICI mesh
+  (reference: src/kvstore/).
+- ``parallel`` is new, TPU-first: device meshes, data/tensor/pipeline/sequence
+  parallelism via jax.sharding + shard_map, ring attention over ppermute.
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from .random import seed
+
+# MXNet-compatible top-level saves (mx.nd.save / mx.nd.load are the canonical
+# entry points; these mirror python/mxnet/ndarray/utils.py).
+from .ndarray import save, load
+
+# Frontend layers: imported when present (they land milestone by milestone;
+# once the build is complete these are all unconditional).
+import importlib as _importlib
+
+for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
+             "gluon", "io", "recordio", "image", "profiler", "runtime",
+             "parallel", "test_utils", "util", "visualization", "operator"):
+    try:
+        globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
+    except ModuleNotFoundError as _e:
+        # only tolerate the module itself not existing yet, not its bugs
+        if _e.name != f"{__name__}.{_mod}":
+            raise
+del _importlib, _mod
+
+if "kvstore" in globals():
+    kv = globals()["kvstore"]
+    KVStore = kv.KVStore
+if "initializer" in globals():
+    init = globals()["initializer"]
+if "optimizer" in globals():
+    lr_scheduler = optimizer.lr_scheduler

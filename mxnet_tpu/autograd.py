@@ -1,0 +1,328 @@
+"""Autograd: imperative tape over JAX VJPs.
+
+Reference parity: src/imperative/imperative.cc (Imperative::Backward, AGInfo
+per-NDArray tape entries) and python/mxnet/autograd.py (record/pause/
+train_mode/predict_mode/backward/grad/Function).
+
+TPU-first design: instead of building an nnvm gradient graph, each recorded
+op stores the ``jax.vjp`` pullback of its pure function.  For hybridized
+blocks the recorded function is ``jax.jit``-wrapped, so both the forward call
+and — because pjit transposes to pjit — the pullback execute as single
+compiled XLA programs: the CachedOp forward/backward pair of the reference,
+compiled by XLA instead of planned by nnvm.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+# -- scope management ----------------------------------------------------------
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode=True):
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, flag
+    return prev
+
+
+# -- tape ----------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: pullback + input links + produced outputs.
+
+    The reference's AGInfo (src/imperative/imperative.cc) keeps op + saved
+    inputs/outputs; here the vjp closure owns the residuals.
+
+    Input links snapshot (array, producer_node, producer_slot) AT RECORD
+    TIME: in-place ops later *adopt* another node's output handle
+    (NDArray._adopt), so chasing ``arr._tape_node`` at backward time would
+    follow the post-mutation producer and mis-route cotangents.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "n_outputs", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.outputs = outputs    # list[NDArray]
+        self.n_outputs = len(outputs)
+        self.name = name
+        links = []
+        for arr in inputs:        # diff positions only
+            parent = arr._tape_node
+            slot = None
+            if parent is not None:
+                slot = next((i for i, o in enumerate(parent.outputs)
+                             if o is arr), None)
+                if slot is None:
+                    parent = None  # stale link (mutated handle): treat leaf
+            links.append((arr, parent, slot))
+        self.inputs = links
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: MXAutogradMarkVariables."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._tape_node = None
+
+
+def _toposort(head_nodes):
+    # iterative post-order DFS: tapes can be arbitrarily deep (long unrolled
+    # RNNs), so no recursion
+    order, seen = [], set()
+    stack = [(n, False) for n in reversed(head_nodes)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for _arr, parent, _slot in node.inputs:
+            if parent is not None and id(parent) not in seen:
+                stack.append((parent, False))
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Walk the tape from `heads`, accumulating gradients into every variable
+    with grad_req != 'null' (reference: Imperative::Backward)."""
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray, _from_jax
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, list):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # seed cotangents
+    cotangents: dict[int, list] = {}  # id(node) -> per-output cotangent
+    node_of: dict[int, TapeNode] = {}
+    # per-variable accumulation across the whole pass; grad_req applied once
+    # at the end (reference: Imperative::Backward writes grad buffers after
+    # the full grad graph executes)
+    var_accum: dict[int, list] = {}  # id(arr) -> [arr, ct_sum]
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        node = h._tape_node
+        if node is None:
+            if h._grad_req != "null":
+                g = jnp.ones_like(h._data) if hg is None else hg._data
+                _accum_var(var_accum, h, g)
+                continue
+            raise MXNetError(
+                "cannot differentiate a head that is not on the tape; "
+                "call backward inside/after autograd.record()")
+        head_nodes.append(node)
+        node_of[id(node)] = node
+        cots = cotangents.setdefault(
+            id(node), [None] * node.n_outputs)
+        idx = next((i for i, o in enumerate(node.outputs) if o is h), None)
+        if idx is None:
+            raise MXNetError(
+                "head array is no longer an output of its producing tape "
+                "node (was it mutated after recording?)")
+        seed = jnp.ones_like(h._data) if hg is None else hg._data
+        cots[idx] = seed if cots[idx] is None else cots[idx] + seed
+
+    order = _toposort(head_nodes)
+    for node in reversed(order):
+        cots = cotangents.get(id(node))
+        if cots is None:
+            continue
+        full = [c if c is not None else jnp.zeros_like(o._data)
+                for c, o in zip(cots, node.outputs)]
+        out_ct = tuple(full) if node.n_outputs > 1 else full[0]
+        in_cts = node.vjp_fn(out_ct)
+        import jax.dtypes
+
+        for (arr, parent, slot), ct in zip(node.inputs, in_cts):
+            if ct is None or (hasattr(ct, "dtype")
+                              and ct.dtype == jax.dtypes.float0):
+                continue
+            if arr._grad_req != "null" and arr._grad is not None:
+                _accum_var(var_accum, arr, ct)
+            if parent is not None:
+                pcots = cotangents.setdefault(
+                    id(parent), [None] * parent.n_outputs)
+                pcots[slot] = ct if pcots[slot] is None else pcots[slot] + ct
+        if not retain_graph:
+            cotangents.pop(id(node), None)
+
+    for arr, ct in var_accum.values():
+        _apply_grad(arr, ct)
+
+    if not retain_graph:
+        for node in order:
+            for out in node.outputs:
+                out._tape_node = None
+
+
+def _accum_var(var_accum, arr, ct):
+    entry = var_accum.get(id(arr))
+    if entry is None:
+        var_accum[id(arr)] = [arr, ct]
+    else:
+        entry[1] = entry[1] + ct
+
+
+def _apply_grad(arr, ct):
+    import jax.numpy as jnp
+
+    ct = ct.astype(arr._grad._data.dtype) if hasattr(ct, "astype") else ct
+    if arr._grad_req == "add":
+        arr._grad._data = arr._grad._data + ct
+    else:  # write
+        arr._grad._data = jnp.asarray(ct)
+    arr._grad._version += 1
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Reference: mx.autograd.grad — return grads w.r.t. `variables` without
+    touching their .grad buffers."""
+    from .ndarray import NDArray, _from_jax
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order imperative grad) is not "
+            "supported; use hybridize + functional jax.grad composition")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    import jax.numpy as jnp
+
+    for v in variables:
+        v._grad = _from_jax(jnp.zeros_like(v._data))
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+        outs = [v._grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return outs[0] if single else outs
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "symbol extraction from the imperative tape is not supported; "
+        "use HybridBlock.export for a serialized graph")
+
+
+class Function:
+    """Custom differentiable function (reference: mx.autograd.Function).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads); call via instance(*inputs).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _from_jax
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(
+                isinstance(i, NDArray) and i._on_tape() for i in inputs):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            def vjp_fn(out_ct):
+                cts = (out_ct,) if single else tuple(out_ct)
+                with pause():
+                    in_grads = self.backward(
+                        *[_from_jax(c) for c in cts])
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return [g._data if isinstance(g, NDArray) else g
+                        for g in in_grads]
+
+            node = TapeNode(vjp_fn, nd_inputs, outs,
+                            name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._tape_node = node
+        return outs[0] if single else outs

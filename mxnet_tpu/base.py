@@ -1,0 +1,118 @@
+"""Base utilities: errors, registries, dtype plumbing.
+
+Reference parity: python/mxnet/base.py (error handling, registry helpers) and
+3rdparty/dmlc-core's parameter/registry machinery.  There is no FFI boundary
+here — the "C API" of the reference (src/c_api/) collapses into direct Python
+calls because the compute core is XLA; the native runtime pieces live in
+``mxnet_tpu/_native`` (C++) and are loaded lazily via ctypes where present.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import numpy as _np
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: MXGetLastError / dmlc::Error)."""
+
+
+_GLOBAL_REGISTRIES: dict[str, dict] = {}
+
+
+def registry(kind: str) -> dict:
+    """Get (creating if needed) a named global registry dict."""
+    return _GLOBAL_REGISTRIES.setdefault(kind, {})
+
+
+class _Registry:
+    """A tiny name->object registry with decorator-style registration.
+
+    Mirrors dmlc::Registry / mx.registry.get_register_func: case-insensitive
+    lookup, alias support.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._store: dict[str, object] = {}
+
+    def register(self, obj=None, name: str | None = None, aliases: tuple = ()):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._store[key] = o
+            for a in aliases:
+                self._store[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name: str):
+        key = str(name).lower()
+        if key not in self._store:
+            raise MXNetError(
+                f"{self.kind} '{name}' is not registered. "
+                f"Known: {sorted(self._store)}"
+            )
+        return self._store[key]
+
+    def __contains__(self, name):
+        return str(name).lower() in self._store
+
+    def keys(self):
+        return self._store.keys()
+
+
+# dtype handling ---------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": "float32", "float64": "float64", "float16": "float16",
+    "bfloat16": "bfloat16", "uint8": "uint8", "int8": "int8",
+    "int32": "int32", "int64": "int64", "bool": "bool",
+}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype spec to a numpy/jax dtype object."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.bfloat16
+        return _np.dtype(dtype)
+    return dtype
+
+
+def getenv_int(name: str, default: int) -> int:
+    """Env config plane (reference: dmlc::GetEnv, docs/faq/env_var.md)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def getenv_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _ThreadLocalStack(threading.local):
+    """with-scope stacks (contexts, autograd state, name scopes)."""
+
+    def __init__(self):
+        self.stack = []
+
+    def push(self, v):
+        self.stack.append(v)
+
+    def pop(self):
+        return self.stack.pop()
+
+    def top(self, default=None):
+        return self.stack[-1] if self.stack else default

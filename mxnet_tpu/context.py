@@ -1,0 +1,119 @@
+"""Device contexts: ``mx.cpu()``, ``mx.tpu(i)``, ``mx.gpu(i)``.
+
+Reference parity: python/mxnet/context.py (Context class, with-scope device
+stack, ``current_context()``).  TPU-first change: a Context resolves to a JAX
+device; ``gpu`` is kept as an alias for the accelerator so reference scripts
+(`ctx=mx.gpu(0)`) run unmodified on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError, _ThreadLocalStack
+
+
+@functools.lru_cache(maxsize=None)
+def _jax_devices(platform: str | None = None):
+    import jax
+
+    try:
+        return tuple(jax.devices(platform) if platform else jax.devices())
+    except RuntimeError:
+        return ()
+
+
+def _accelerator_platform() -> str | None:
+    """Return the non-CPU platform name if one is present (tpu preferred)."""
+    import jax
+
+    platforms = {d.platform for d in jax.devices()}
+    for p in ("tpu", "axon", "gpu", "cuda", "rocm"):
+        if p in platforms:
+            return p
+    return None
+
+
+class Context:
+    """A device context. devtype in {'cpu', 'tpu', 'gpu'}.
+
+    ``gpu`` is an accelerator alias: on a TPU machine ``mx.gpu(0)`` is the
+    first TPU chip, so reference training scripts port without edits.
+    """
+
+    devtype2mask = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3}
+    _stack = _ThreadLocalStack()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in ("cpu", "gpu", "tpu", "cpu_pinned"):
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = "cpu" if device_type == "cpu_pinned" else device_type
+        self.device_id = int(device_id)
+
+    # -- resolution to a JAX device -------------------------------------------
+    @property
+    def jax_device(self):
+        if self.device_type == "cpu":
+            devs = _jax_devices("cpu")
+        else:
+            plat = _accelerator_platform()
+            devs = _jax_devices(plat) if plat else ()
+            if not devs:  # no accelerator: fall back to CPU transparently
+                devs = _jax_devices("cpu")
+        if not devs:
+            raise MXNetError(f"no JAX device for context {self}")
+        return devs[self.device_id % len(devs)]
+
+    # -- identity -------------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- with-scope -----------------------------------------------------------
+    def __enter__(self):
+        Context._stack.push(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._stack.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        return cls._stack.top(default=Context("cpu", 0))
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Accelerator alias (reference scripts use mx.gpu); maps to TPU here."""
+    return Context("gpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_gpus() -> int:
+    plat = _accelerator_platform()
+    return len(_jax_devices(plat)) if plat else 0
+
+
+def num_tpus() -> int:
+    return num_gpus()

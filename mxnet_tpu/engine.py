@@ -1,0 +1,61 @@
+"""Execution-engine shim.
+
+Reference parity: src/engine/ (ThreadedEnginePerDevice / NaiveEngine,
+Engine::WaitForAll, async exception propagation re-thrown at WaitToRead).
+
+TPU-first design: XLA/PJRT dispatch is already asynchronous with dataflow
+ordering, so there is no hand-built dependency engine.  What remains here is
+the *policy* surface the reference exposes:
+
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` → every op blocks until complete
+  (bisecting async bugs, reference: src/engine/naive_engine.cc);
+- ``wait_all()`` → drain all in-flight device work
+  (reference: Engine::WaitForAll);
+- deferred errors: JAX raises device errors at block time, matching the
+  reference's re-throw-at-WaitToRead semantics (tests/python/unittest/
+  test_exc_handling.py is mirrored by tests/test_engine.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "").lower() == "naiveengine"
+
+
+def is_naive() -> bool:
+    return _NAIVE
+
+
+def set_engine_type(name: str) -> None:
+    """'NaiveEngine' → synchronous; anything else → async (default)."""
+    global _NAIVE
+    _NAIVE = name.lower() == "naiveengine"
+
+
+def maybe_sync(arr):
+    """Block on an array if NaiveEngine mode is on. Returns the array."""
+    if _NAIVE and hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return arr
+
+
+def wait_all() -> None:
+    """Block until all asynchronously dispatched work has completed."""
+    import jax
+
+    # PJRT exposes no global barrier; syncing every live array is the
+    # equivalent drain.  jax.live_arrays() covers everything dispatched.
+    for a in jax.live_arrays():
+        a.block_until_ready()
+
+
+def bulk(size: int | None = None):
+    """Reference compat: engine bulking (MXNET_EXEC_BULK_EXEC_*).
+
+    XLA fuses within a jit region, so bulking is a no-op context manager kept
+    for API compatibility with mx.engine.bulk.
+    """
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,149 @@
+"""mx.nd — the imperative NDArray API.
+
+Reference parity: python/mxnet/ndarray/ — the module namespace carries the
+NDArray class, creation functions, and every registered op as a generated
+wrapper (codegen analog of register.py's _init_ops).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from .ndarray import NDArray, _from_jax
+from . import register as _register
+from .utils import save, load
+
+
+def _device(ctx):
+    ctx = ctx or current_context()
+    return ctx.jax_device, ctx
+
+
+def array(source_array, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(source_array, NDArray):
+        out = source_array.astype(dtype) if dtype else source_array.copy()
+        return out.as_in_context(ctx) if ctx else out
+    dev, ctx = _device(ctx)
+    np_arr = _np.asarray(source_array,
+                         dtype=None if dtype in ("bfloat16", None) else dtype)
+    if dtype is None and np_arr.dtype != _np.bool_:
+        # reference semantics: default dtype is float32 for any non-NDArray
+        # source (python/mxnet/ndarray/ndarray.py `array`)
+        np_arr = np_arr.astype(_np.float32)
+    arr = jax.device_put(jnp.asarray(np_arr), dev)
+    if dtype == "bfloat16":
+        arr = arr.astype(jnp.bfloat16)
+    return NDArray(arr, ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.zeros(shape, np_dtype(dtype)), dev),
+                   ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.ones(shape, np_dtype(dtype)), dev),
+                   ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.full(shape, val, np_dtype(dtype)),
+                                  dev), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    out = jnp.arange(start, stop, step, np_dtype(dtype or "float32"))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(jax.device_put(out, dev), ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    return NDArray(jax.device_put(
+        jnp.linspace(start, stop, num, endpoint=endpoint,
+                     dtype=np_dtype(dtype or "float32")), dev), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    dev, ctx = _device(ctx)
+    return NDArray(jax.device_put(
+        jnp.eye(N, M or None, k, np_dtype(dtype)), dev), ctx)
+
+
+def from_numpy(a, zero_copy=False):
+    return array(a)
+
+
+def from_dlpack(capsule):
+    import jax
+
+    return NDArray(jax.dlpack.from_dlpack(capsule))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _register.invoke_registered("concat", tuple(arrays),
+                                      {"dim": axis})
+
+
+def waitall():
+    from .. import engine
+
+    engine.wait_all()
+
+
+def moveaxis(a, source, destination):
+    return a._apply(lambda d: _jnp().moveaxis(d, source, destination))
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# control-flow higher-order ops (reference keeps them under contrib)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402
+
+# generated op wrappers → module namespace
+_register.populate(globals())
+
+# sub-namespaces
+from . import random  # noqa: E402
+from . import linalg  # noqa: E402
+from . import contrib  # noqa: E402
+from . import sparse  # noqa: E402

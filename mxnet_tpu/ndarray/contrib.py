@@ -1,0 +1,37 @@
+"""mx.nd.contrib — contrib namespace (python/mxnet/ndarray/contrib.py).
+
+Control flow (foreach/while_loop/cond) + misc contrib ops.  Detection ops
+(box_nms, ROIAlign, MultiBox*) are registered in mxnet_tpu.ops.contrib_ops
+and surface here via the generated wrappers.
+"""
+
+from __future__ import annotations
+
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from ..ops import registry as _registry
+from . import register as _register
+
+
+def _expose(namespace=None):
+    ns = namespace if namespace is not None else globals()
+    for name, opdef in _registry.all_ops().items():
+        if name.startswith("contrib_"):
+            public = name[len("contrib_"):]
+            ns.setdefault(public, _register._make_wrapper(opdef))
+
+
+def div_sqrt_dim(data):
+    return data / (data.shape[-1] ** 0.5)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    from . import arange
+
+    if axis is None:
+        n = data.size
+    else:
+        n = data.shape[axis]
+    return arange(start, start + step * n, step, repeat=repeat)
+
+
+_expose()

@@ -1,0 +1,39 @@
+"""mx.nd.linalg — linear algebra namespace (python/mxnet/ndarray/linalg.py).
+
+Op names drop the `linalg_` prefix, matching the reference namespace.
+"""
+
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from . import register as _register
+
+
+def _alias(public, opname):
+    opdef = _registry.get(opname)
+
+    def f(*args, **kwargs):
+        return _register.invoke(opdef, args, kwargs)
+
+    f.__name__ = public
+    return f
+
+
+gemm = _alias("gemm", "linalg_gemm")
+gemm2 = _alias("gemm2", "linalg_gemm2")
+potrf = _alias("potrf", "linalg_potrf")
+potri = _alias("potri", "linalg_potri")
+trsm = _alias("trsm", "linalg_trsm")
+trmm = _alias("trmm", "linalg_trmm")
+syrk = _alias("syrk", "linalg_syrk")
+gelqf = _alias("gelqf", "linalg_gelqf")
+syevd = _alias("syevd", "linalg_syevd")
+sumlogdiag = _alias("sumlogdiag", "linalg_sumlogdiag")
+extractdiag = _alias("extractdiag", "linalg_extractdiag")
+makediag = _alias("makediag", "linalg_makediag")
+extracttrian = _alias("extracttrian", "linalg_extracttrian")
+maketrian = _alias("maketrian", "linalg_maketrian")
+inverse = _alias("inverse", "linalg_inverse")
+det = _alias("det", "linalg_det")
+slogdet = _alias("slogdet", "linalg_slogdet")
+svd = _alias("svd", "linalg_svd")

@@ -1,0 +1,379 @@
+"""The NDArray: MXNet's array semantics on immutable XLA buffers.
+
+Reference parity: include/mxnet/ndarray.h + src/ndarray/ndarray.cc +
+python/mxnet/ndarray/ndarray.py.
+
+Design notes (TPU-first):
+- The underlying ``jax.Array`` is immutable; MXNet's in-place mutation
+  (``x += 1``, ``x[2:5] = v``, optimizer updates) becomes handle swapping —
+  ``self._data`` is replaced and ``self._version`` bumped.  This preserves the
+  reference's aliasing-visible semantics at the Python level while every
+  actual buffer stays functional for XLA (and the autograd tape can never be
+  corrupted by mutation, unlike the reference which must version-check).
+- Asynchrony comes from PJRT: ops return immediately with futures;
+  ``wait_to_read()`` = ``block_until_ready()``; device errors surface at the
+  sync point, matching the reference's deferred-exception semantics
+  (src/engine/threaded_engine.cc exception propagation).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from .. import engine
+
+
+def _is_jax_array(x):
+    import jax
+
+    return isinstance(x, jax.Array) or hasattr(x, "aval")
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
+                 "_tape_node", "_stype", "__weakref__")
+
+    # make NumPy defer to NDArray dunders (mx.nd semantics)
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None, stype="default"):
+        self._data = data
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._stype = stype
+
+    # -- basic properties ------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        dt = self._data.dtype
+        return dt.type if hasattr(dt, "type") and dt.type.__module__ == "numpy" else dt
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+            plat = dev.platform
+        except Exception:
+            return current_context()
+        if plat == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):  # reference-compat attribute
+        return self._data
+
+    @property
+    def version(self):
+        return self._version
+
+    def _on_tape(self):
+        return self._tape_node is not None or self._grad_req != "null"
+
+    # -- sync / host transfer --------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # -- device movement -------------------------------------------------------
+    def as_in_context(self, ctx):
+        import jax
+
+        if ctx == self.context:
+            return self
+        out = jax.device_put(self._data, ctx.jax_device)
+        return NDArray(out, ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        import jax
+
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device),
+                           other)
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(
+                self._data.astype(other._data.dtype),
+                list(other._data.devices())[0])
+            other._version += 1
+            return other
+        raise MXNetError(f"cannot copyto {type(other)}")
+
+    def copy(self):
+        return NDArray(self._data, self._ctx)
+
+    def astype(self, dtype, copy=True):
+        return self._apply(lambda d: d.astype(np_dtype(dtype)))
+
+    # -- autograd --------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        import jax.numpy as jnp
+
+        self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        self._grad_req = grad_req
+        self._tape_node = None
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else
+                          None, retain_graph, train_mode)
+
+    # -- op plumbing -----------------------------------------------------------
+    def _apply(self, fn, *others, name=""):
+        """Run fn over the raw arrays (self first), with tape recording."""
+        from .register import invoke_simple
+
+        return invoke_simple(fn, (self,) + others, name=name)
+
+    # -- mutation (handle-swap) ------------------------------------------------
+    def _set_data(self, jarr):
+        self._data = engine.maybe_sync(jarr)
+        self._version += 1
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        key = _unwrap_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, tuple) and len(key) == 0:
+            key = Ellipsis
+        self._set_data(self._data.at[key].set(value))
+
+    def __getitem__(self, key):
+        nd_keys = []
+        key2 = _unwrap_index(key)
+        return self._apply(lambda d: d[key2], name="getitem")
+
+    # -- python protocol -------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        arr = self.asnumpy()
+        return f"\n{arr}\n<NDArray {'x'.join(map(str, self.shape))} " \
+               f"@{self.context}>"
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kwargs):
+        return self._data.__dlpack__(**kwargs)
+
+    # NDArray equality is elementwise (reference semantics) → unhashable.
+    __hash__ = None  # type: ignore
+
+    # -- arithmetic ------------------------------------------------------------
+    def _binop(self, other, opname, reverse=False):
+        from .register import invoke_registered
+
+        if isinstance(other, _np.ndarray):
+            import jax.numpy as jnp
+
+            other = NDArray(jnp.asarray(other))
+        a, b = (other, self) if reverse else (self, other)
+        return invoke_registered(opname, (a, b), {})
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod")
+
+    def __rmod__(self, o):
+        return self._binop(o, "broadcast_mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", reverse=True)
+
+    def __matmul__(self, o):
+        return self._binop(o, "dot")
+
+    def __neg__(self):
+        return self._apply(lambda d: -d, name="negative")
+
+    def __abs__(self):
+        return self._apply(lambda d: abs(d), name="abs")
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal")
+
+    # in-place: handle swap (see module docstring)
+    def _adopt(self, out):
+        """Take over `out`'s buffer and tape position (in-place semantics)."""
+        self._data = out._data
+        self._version += 1
+        self._tape_node = out._tape_node
+        if self._tape_node is not None:
+            outs = self._tape_node.outputs
+            for i, o in enumerate(outs):
+                if o is out:
+                    outs[i] = self  # the node now produces *this* handle
+                    break
+        return self
+
+    def _ibinop(self, other, opname):
+        return self._adopt(self._binop(other, opname))
+
+    def __iadd__(self, o):
+        return self._ibinop(o, "broadcast_add")
+
+    def __isub__(self, o):
+        return self._ibinop(o, "broadcast_sub")
+
+    def __imul__(self, o):
+        return self._ibinop(o, "broadcast_mul")
+
+    def __itruediv__(self, o):
+        return self._ibinop(o, "broadcast_div")
+
+    # -- sparse-compat ---------------------------------------------------------
+    def tostype(self, stype):
+        out = NDArray(self._data, self._ctx, stype=stype)
+        return out
+
+    # reshape needs to support reshape(2,3), reshape((2,3)), and special codes
+    def reshape(self, *shape, **kwargs):
+        from .register import invoke_registered
+
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape and "shape" in kwargs:
+            shape = kwargs.pop("shape")
+        return invoke_registered("reshape", (self,),
+                                 {"shape": shape, **kwargs})
+
+    def reshape_like(self, other):
+        from .register import invoke_registered
+
+        return invoke_registered("reshape_like", (self, other), {})
+
+
+def _unwrap_index(key):
+    if isinstance(key, NDArray):
+        import jax.numpy as jnp
+
+        k = key._data
+        return k.astype(jnp.int32) if jnp.issubdtype(k.dtype, jnp.floating) \
+            else k
+    if isinstance(key, tuple):
+        return tuple(_unwrap_index(k) for k in key)
+    return key
+
+
+def _from_jax(arr, ctx=None) -> NDArray:
+    return NDArray(arr, ctx)

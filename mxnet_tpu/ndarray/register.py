@@ -1,0 +1,195 @@
+"""Generated NDArray op wrappers + the eager invoke path.
+
+Reference parity: python/mxnet/ndarray/register.py (import-time codegen over
+MXListAllOpNames) + src/imperative/imperative.cc Imperative::Invoke.
+
+The wrapper is polymorphic:
+- NDArray inputs → eager path: unwrap, run the pure op (JAX dispatches it
+  asynchronously — the engine analog), wrap outputs; when autograd is
+  recording and an input is on the tape, record a TapeNode holding the
+  jax.vjp pullback.
+- jax arrays / tracers → pure pass-through, so the same `mx.nd.*` surface
+  works inside `hybridize()` traces and user jit code.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import autograd as _ag
+from ..ops import registry as _registry
+from ..ops.registry import OpDef
+from .ndarray import NDArray, _from_jax
+
+
+def _inject(opdef: OpDef, kwargs: dict) -> dict:
+    if opdef.mode_dependent and kwargs.get("_is_training") is None:
+        kwargs = dict(kwargs)
+        kwargs["_is_training"] = _ag.is_training()
+    if opdef.random and kwargs.get("_key") is None:
+        from ..random import next_key
+
+        kwargs = dict(kwargs)
+        kwargs["_key"] = next_key()
+    return kwargs
+
+
+def invoke(opdef: OpDef, args: tuple, kwargs: dict):
+    # frontend-only kwargs accepted by every reference op wrapper
+    out_arr = kwargs.pop("out", None)
+    req_ctx = kwargs.pop("ctx", None)
+    name = kwargs.pop("name", None)  # symbol-compat: ignored eagerly
+    kwargs = _inject(opdef, kwargs)
+    fn = opdef.fn
+    if out_arr is not None or req_ctx is not None:
+        result = _invoke_inner(opdef, fn, args, kwargs)
+        return _finalize(result, out_arr, req_ctx)
+    return _invoke_inner(opdef, fn, args, kwargs)
+
+
+def _finalize(result, out_arr, req_ctx):
+    import jax
+
+    if req_ctx is not None and isinstance(result, NDArray):
+        result = result.as_in_context(req_ctx)
+    if out_arr is not None:
+        src = result[0] if isinstance(result, tuple) else result
+        if isinstance(src, NDArray):
+            out_arr._adopt(src)  # keeps the tape position (out= records too)
+        else:
+            out_arr._data = src
+            out_arr._version += 1
+        return out_arr
+    return result
+
+
+def _invoke_inner(opdef: OpDef, fn, args: tuple, kwargs: dict):
+    if opdef.opaque:
+        return fn(*args, **kwargs)  # host-level op: handles NDArrays itself
+
+    slots = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    kslots = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+    if not slots and not kslots:
+        # pure path if any user arg is a jax array/tracer, or any injected
+        # arg (e.g. _key under a traced key_scope) is a tracer
+        if _any_jax(args) or _any_jax(
+                v for k, v in kwargs.items() if not k.startswith("_")) or \
+                _any_tracer(kwargs.values()):
+            return fn(*args, **kwargs)
+        # creation-style op called eagerly (no array inputs): wrap output
+        out = fn(*args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(_wrap(o, None) for o in out)
+        return _wrap(out, None)
+
+    nd_list = [args[i] for i in slots] + [kwargs[k] for k in kslots]
+    arrs = [x._data for x in nd_list]
+
+    def pure_fn(*raw):
+        a2 = list(args)
+        k2 = dict(kwargs)
+        it = iter(raw)
+        for i in slots:
+            a2[i] = next(it)
+        for k in kslots:
+            k2[k] = next(it)
+        return fn(*a2, **k2)
+
+    ctx = nd_list[0]._ctx
+    recording = _ag.is_recording() and any(x._on_tape() for x in nd_list)
+    if recording:
+        import jax
+
+        out, vjp_fn = jax.vjp(pure_fn, *arrs)
+        single = not isinstance(out, (tuple, list))
+        outs_j = [out] if single else list(out)
+        outs = [_wrap(o, ctx) for o in outs_j]
+        node = _ag.TapeNode(vjp_fn, nd_list, outs, name=opdef.name)
+        for o in outs:
+            if isinstance(o, NDArray):
+                o._tape_node = node
+        return outs[0] if single else tuple(outs)
+
+    out = pure_fn(*arrs)
+    if isinstance(out, (tuple, list)):
+        return tuple(_wrap(o, ctx) for o in out)
+    return _wrap(out, ctx)
+
+
+def _any_jax(xs) -> bool:
+    import jax
+
+    return any(isinstance(x, (jax.Array, jax.core.Tracer)) for x in xs)
+
+
+def _any_tracer(xs) -> bool:
+    import jax
+
+    return any(isinstance(x, jax.core.Tracer) for x in xs)
+
+
+def _wrap(o, ctx):
+    from .. import engine
+
+    if hasattr(o, "shape") and hasattr(o, "dtype"):
+        return _from_jax(engine.maybe_sync(o), ctx)
+    return o
+
+
+def invoke_registered(name: str, args: tuple, kwargs: dict):
+    return invoke(_registry.get(name), args, kwargs)
+
+
+def invoke_simple(fn, args: tuple, kwargs: dict | None = None, name=""):
+    """Invoke an unregistered pure function with full NDArray/tape handling
+    (used for indexing and other ad-hoc dunder ops)."""
+    return invoke(OpDef(name or getattr(fn, "__name__", "fn"), fn),
+                  args, kwargs or {})
+
+
+def _make_wrapper(opdef: OpDef):
+    def wrapper(*args, **kwargs):
+        return invoke(opdef, args, kwargs)
+
+    wrapper.__name__ = opdef.name
+    wrapper.__qualname__ = opdef.name
+    wrapper.__doc__ = (opdef.fn.__doc__ or "") + \
+        f"\n\n(generated NDArray wrapper for op '{opdef.name}')"
+    return wrapper
+
+
+def populate(namespace: dict, names=None):
+    """Generate wrappers for every registered op into `namespace`
+    (reference: _init_ops in python/mxnet/ndarray/register.py)."""
+    for name, opdef in _registry.all_ops().items():
+        if names is not None and name not in names:
+            continue
+        if name not in namespace:
+            namespace[name] = _make_wrapper(opdef)
+
+
+# NDArray instance methods generated from ops (mx.nd.NDArray method surface).
+_METHOD_OPS = [
+    "sum", "mean", "prod", "max", "min", "argmax", "argmin", "norm",
+    "transpose", "flatten", "expand_dims", "squeeze", "clip", "abs",
+    "exp", "log", "sqrt", "square", "sigmoid", "tanh", "relu", "softmax",
+    "log_softmax", "slice_axis", "take", "flip", "tile", "repeat", "pad",
+    "round", "floor", "ceil", "split", "one_hot", "topk", "sort", "argsort",
+    "swapaxes", "broadcast_to", "broadcast_like", "slice_like", "sign",
+    "zeros_like", "ones_like", "stop_gradient", "diag", "cumsum",
+]
+
+
+def _attach_methods():
+    for name in _METHOD_OPS:
+        if name in _registry.all_ops() and not hasattr(NDArray, name):
+            opdef = _registry.get(name)
+
+            def method(self, *a, _opdef=opdef, **kw):
+                return invoke(_opdef, (self,) + a, kw)
+
+            method.__name__ = name
+            setattr(NDArray, name, method)
+
+
+_attach_methods()

@@ -1,0 +1,143 @@
+"""mx.nd.sparse — sparse NDArray API surface.
+
+Reference parity: python/mxnet/ndarray/sparse.py (RowSparseNDArray,
+CSRNDArray, row_sparse_array, csr_matrix).
+
+TPU-first design decision: XLA has no sparse buffer layout, and on TPU the
+MXU/VPU want dense tiles — the reference's sparse storage exists to optimize
+*CPU/PCIe-era* embedding gradients and parameter-server traffic.  Here sparse
+arrays are VIEWS carrying stype metadata plus the compressed components,
+backed by dense compute.  ``row_sparse`` keeps (indices, values) so
+`row_sparse_pull`-style flows and sparse serialization remain expressible;
+compute densifies lazily.  This preserves the full API while XLA's
+scatter/gather fusion covers the perf case that matters on TPU
+(Embedding with sparse_grad lowers to scatter-add, not a dense update).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _from_jax
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Dense-backed row_sparse array; `indices`/`data` recover components."""
+
+    __slots__ = ("_rs_indices",)
+
+    def __init__(self, data, ctx=None, indices=None):
+        super().__init__(data, ctx, stype="row_sparse")
+        self._rs_indices = indices
+
+    @property
+    def indices(self):
+        import jax.numpy as jnp
+
+        if self._rs_indices is not None:
+            return _from_jax(self._rs_indices)
+        nz = _np.nonzero(_np.abs(self.asnumpy()).reshape(
+            self.shape[0], -1).sum(axis=1))[0]
+        return _from_jax(jnp.asarray(nz.astype(_np.int64)))
+
+    @property
+    def data(self):
+        import jax.numpy as jnp
+
+        idx = self.indices._data
+        return _from_jax(jnp.take(self._data, idx, axis=0))
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        return self
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx, stype="csr")
+
+    @property
+    def indptr(self):
+        import jax.numpy as jnp
+
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        return _from_jax(jnp.asarray(
+            _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int64)))
+
+    @property
+    def indices(self):
+        import jax.numpy as jnp
+
+        a = self.asnumpy()
+        return _from_jax(jnp.asarray(_np.nonzero(a)[1].astype(_np.int64)))
+
+    @property
+    def data(self):
+        import jax.numpy as jnp
+
+        a = self.asnumpy()
+        return _from_jax(jnp.asarray(a[a != 0]))
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        return self
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not isinstance(
+            arg1[0], (int, float)):
+        data, indices = arg1
+        data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
+        indices = _np.asarray(
+            getattr(indices, "asnumpy", lambda: indices)()).astype(_np.int64)
+        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:]
+                               if len(indices) else (0,) + data.shape[1:])
+        dense = _np.zeros(full_shape, dtype=dtype or data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense),
+                                indices=jnp.asarray(indices))
+    a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)(),
+                    dtype=dtype or "float32")
+    return RowSparseNDArray(jnp.asarray(a))
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = (
+            _np.asarray(getattr(x, "asnumpy", lambda x=x: x)())
+            for x in arg1)
+        n_rows = len(indptr) - 1
+        n_cols = shape[1] if shape else int(indices.max()) + 1
+        dense = _np.zeros((n_rows, n_cols), dtype=dtype or data.dtype)
+        for r in range(n_rows):
+            for j in range(int(indptr[r]), int(indptr[r + 1])):
+                dense[r, int(indices[j])] = data[j]
+        return CSRNDArray(jnp.asarray(dense))
+    a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)(),
+                    dtype=dtype or "float32")
+    return CSRNDArray(jnp.asarray(a))
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from . import zeros as dense_zeros
+
+    base = dense_zeros(shape, ctx, dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(base._data, base._ctx)
+    if stype == "csr":
+        return CSRNDArray(base._data, base._ctx)
+    return base

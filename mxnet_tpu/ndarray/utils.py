@@ -1,0 +1,134 @@
+"""NDArray serialization: mx.nd.save / mx.nd.load.
+
+Reference parity: src/ndarray/ndarray.cc NDArray::Save/Load + the
+kMXAPINDArrayListMagic container written by MXNDArraySave (src/c_api/c_api.cc).
+Format (little-endian), best-effort byte-compatible with the reference's
+``.params`` files so upstream model-zoo weights load directly:
+
+  container:  uint64 0x112 (kMXAPINDArrayListMagic), uint64 reserved=0,
+              uint64 n_arrays, n_arrays × NDArray records,
+              uint64 n_names, n_names × (uint64 len, bytes) names
+  ndarray:    uint32 0xF993fac9 (NDARRAY_V2_MAGIC), int32 stype (-1 dense),
+              uint32 ndim, int64[ndim] shape, int32 dev_type, int32 dev_id,
+              int32 type_flag, raw data bytes
+
+NOTE: the reference mount was empty at survey time (SURVEY.md preamble);
+field order follows upstream apache/incubator-mxnet 1.x and must be
+re-verified against the fork if the mount is populated.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _from_jax
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC_V2 = 0xF993FAC9
+_ND_MAGIC_V1 = 0xF993FAC8
+
+# reference type flags (mshadow/base.h)
+_TYPE_FLAGS = {
+    _np.dtype("float32"): 0, _np.dtype("float64"): 1,
+    _np.dtype("float16"): 2, _np.dtype("uint8"): 3,
+    _np.dtype("int32"): 4, _np.dtype("int8"): 5, _np.dtype("int64"): 6,
+}
+_FLAG_TYPES = {v: k for k, v in _TYPE_FLAGS.items()}
+_BF16_FLAG = 12  # extension flag for bfloat16 (not in 1.x reference)
+
+
+def _save_ndarray(f, arr: NDArray):
+    a = arr.asnumpy()
+    dt = a.dtype
+    if dt.name == "bfloat16":
+        flag = _BF16_FLAG
+        raw = a.view(_np.uint16)
+    elif dt == _np.dtype("bool"):
+        a = a.astype("uint8")
+        flag = _TYPE_FLAGS[a.dtype]
+        raw = a
+    else:
+        if dt not in _TYPE_FLAGS:
+            a = a.astype("float32")
+            dt = a.dtype
+        flag = _TYPE_FLAGS[dt]
+        raw = a
+    f.write(struct.pack("<I", _ND_MAGIC_V2))
+    f.write(struct.pack("<i", -1))  # dense storage type
+    f.write(struct.pack("<I", a.ndim))
+    f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+    f.write(struct.pack("<ii", 1, 0))  # context: cpu(0) — ctx stripped on save
+    f.write(struct.pack("<i", flag))
+    f.write(raw.tobytes())
+
+
+def _load_ndarray(f) -> NDArray:
+    import jax.numpy as jnp
+
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic == _ND_MAGIC_V2:
+        (stype,) = struct.unpack("<i", f.read(4))
+        if stype not in (-1,):
+            raise MXNetError(f"sparse storage type {stype} in file not "
+                             "supported (dense-only on TPU)")
+        (ndim,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+    elif magic == _ND_MAGIC_V1:
+        (ndim,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack(f"<{ndim}I", f.read(4 * ndim)) if ndim else ()
+    else:
+        raise MXNetError(f"invalid NDArray magic {magic:#x}")
+    struct.unpack("<ii", f.read(8))  # context (ignored; load to default)
+    (flag,) = struct.unpack("<i", f.read(4))
+    n = 1
+    for s in shape:
+        n *= s
+    if flag == _BF16_FLAG:
+        raw = _np.frombuffer(f.read(2 * n), dtype=_np.uint16)
+        arr = jnp.asarray(raw).view(jnp.bfloat16).reshape(shape)
+    else:
+        dt = _FLAG_TYPES[flag]
+        raw = _np.frombuffer(f.read(dt.itemsize * n), dtype=dt)
+        arr = jnp.asarray(raw.reshape(shape))
+    return _from_jax(arr)
+
+
+def save(fname: str, data) -> None:
+    """Save a list or str->NDArray dict (``.params`` format)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        names, arrays = [], list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _save_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nme in names:
+            b = nme.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname: str):
+    """Load a ``.params`` file → dict (named) or list (unnamed)."""
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError(f"invalid .params magic {magic:#x}")
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_ndarray(f) for _ in range(count)]
+        (n_names,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

@@ -1,0 +1,123 @@
+"""Custom operators: mx.operator.CustomOp / CustomOpProp / register.
+
+Reference parity: src/operator/custom/custom.cc + python/mxnet/operator.py —
+user-defined ops written as Python callbacks, invoked via
+``mx.nd.Custom(..., op_type=name)``.
+
+TPU-first note: a CustomOp's forward/backward run eagerly on NDArrays (host
+roundtrip), exactly like the reference's python-callback path.  Performance-
+critical custom kernels should instead be pure-JAX/Pallas functions
+registered with ``mxnet_tpu.ops.register`` — that is this framework's analog
+of writing a C++/CUDA operator.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError, _Registry
+
+_custom_registry = _Registry("custom_op")
+
+
+class CustomOp:
+    """Base for custom op implementations (forward/backward on NDArrays)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        raw = src._data if hasattr(src, "_data") else src
+        if req in ("write", "inplace", None):
+            dst._set_data(raw)
+        elif req == "add":
+            dst._set_data(dst._data + raw)
+        # req == 'null': no-op
+
+
+class CustomOpProp:
+    """Shape/type/arg metadata for a custom op (reference: CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Decorator: @mx.operator.register("myop") on a CustomOpProp subclass."""
+
+    def _do(prop_cls):
+        _custom_registry.register(prop_cls, name=reg_name)
+        return prop_cls
+
+    return _do
+
+
+def get(name):
+    return _custom_registry.get(name)
+
+
+def _invoke_custom(op_type, data, kwargs):
+    """Backend for the registered 'Custom' op (mxnet_tpu/ops/nn.py)."""
+    from . import autograd
+    from .ndarray import _from_jax
+    from .ndarray.ndarray import NDArray
+
+    if op_type is None or op_type not in _custom_registry:
+        raise MXNetError(
+            f"Custom op_type {op_type!r} is not registered; use "
+            "@mx.operator.register(name) on a CustomOpProp subclass")
+    prop_cls = _custom_registry.get(op_type)
+    prop = prop_cls(**kwargs) if kwargs else prop_cls()
+
+    inputs = [d if isinstance(d, NDArray) else _from_jax(d) for d in data]
+    in_shapes = [list(i.shape) for i in inputs]
+    in_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes, [i.dtype for i in inputs])
+
+    from . import nd
+
+    out_data = [nd.zeros(tuple(s)) for s in out_shapes]
+    aux = [nd.zeros(tuple(s)) for s in aux_shapes]
+
+    class _Fn(autograd.Function):
+        def forward(self, *ins):
+            op.forward(autograd.is_training(), ["write"] * len(out_data),
+                       list(ins), out_data, aux)
+            self.save_for_backward(list(ins), out_data)
+            return tuple(out_data) if len(out_data) > 1 else out_data[0]
+
+        def backward(self, *ograds):
+            ins, outs = self._saved
+            in_grad = [nd.zeros(i.shape) for i in ins]
+            op.backward(["write"] * len(in_grad), list(ograds), ins, outs,
+                        in_grad, aux)
+            return tuple(in_grad) if len(in_grad) > 1 else in_grad[0]
+
+    return _Fn()(*inputs)

@@ -1,0 +1,24 @@
+"""The operator library: pure JAX functions, registered by reference name.
+
+Reference parity: src/operator/** — see the per-module docstrings.  This
+namespace exposes the *pure* functions (operating on jax arrays); the
+NDArray-aware generated wrappers live in ``mxnet_tpu.ndarray``.
+"""
+
+from . import registry
+from .registry import register, get, list_ops, all_ops
+
+from . import elemwise
+from . import reduce as reduce_ops
+from . import matrix
+from . import indexing
+from . import nn
+from . import random_ops
+from . import linalg
+from . import control_flow
+
+# Re-export every registered pure function at module level so that
+# `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.
+for _name, _opdef in registry.all_ops().items():
+    globals().setdefault(_name, _opdef.fn)
+del _name, _opdef

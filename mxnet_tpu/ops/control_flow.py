@@ -1,0 +1,118 @@
+"""Control-flow ops: foreach, while_loop, cond.
+
+Reference parity: src/operator/control_flow.cc (higher-order ops with
+subgraphs, landed in MXNet 1.3; Python frontend
+python/mxnet/ndarray/contrib.py).  TPU-first: these map 1:1 onto
+lax.scan / lax.while_loop / lax.cond, which is exactly the compiler-friendly
+control flow XLA wants — no graph-cutting or subgraph ops needed.
+
+The functions here accept either NDArrays or jax arrays (they run the body
+through the polymorphic frontend), so they work eagerly and under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _unwrap(x):
+    from ..ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap_like(x, template):
+    from ..ndarray import NDArray, _from_jax
+
+    if isinstance(template, NDArray) or (
+            isinstance(template, (list, tuple)) and any(
+                isinstance(t, NDArray) for t in template)):
+        if isinstance(x, (list, tuple)):
+            return type(x)(_from_jax(v) for v in x)
+        return _from_jax(x)
+    return x
+
+
+def foreach(body, data, init_states):
+    """scan `body` over the leading axis of `data`.
+
+    body(step_data, states) -> (outputs, new_states)
+    Returns (stacked_outputs, final_states).
+    """
+    jdata = _unwrap(data)
+    jstates = _unwrap(init_states)
+
+    def scan_body(carry, x):
+        out, new_states = body(x, carry)
+        return new_states, out
+
+    final, outs = lax.scan(scan_body, jstates, jdata)
+    return _wrap_like(outs, data), _wrap_like(final, init_states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference semantics: run func while cond holds, up to max_iterations.
+
+    func(*loop_vars) -> (step_output, new_loop_vars).  Outputs are stacked
+    into a max_iterations-sized buffer (XLA needs static shapes); entries
+    beyond the actual iteration count are zeros, and the true count is
+    recoverable from the returned loop vars.
+    """
+    jvars = _unwrap(loop_vars)
+    if max_iterations is None:
+        # no outputs requested: plain while loop
+        def body(vs):
+            _, new_vs = func(*vs)
+            return tuple(_unwrap(new_vs))
+
+        out_vars = lax.while_loop(
+            lambda vs: jnp.asarray(_unwrap(cond(*vs))).reshape(()), body,
+            tuple(jvars))
+        return [], _wrap_like(list(out_vars), loop_vars)
+
+    # probe one step to learn output structure
+    probe_out, _ = func(*loop_vars)
+    probe_out = _unwrap(probe_out)
+    single = not isinstance(probe_out, (list, tuple))
+    probe_list = [probe_out] if single else list(probe_out)
+    bufs = [jnp.zeros((max_iterations,) + tuple(p.shape), p.dtype)
+            for p in probe_list]
+
+    def body(carry):
+        i, vs, bufs = carry
+        out, new_vs = func(*vs)
+        out = _unwrap(out)
+        out_list = [out] if single else list(out)
+        bufs = tuple(b.at[i].set(o) for b, o in zip(bufs, out_list))
+        return i + 1, tuple(_unwrap(new_vs)), bufs
+
+    def cond_fn(carry):
+        i, vs, _ = carry
+        return jnp.logical_and(
+            i < max_iterations,
+            jnp.asarray(_unwrap(cond(*vs))).reshape(()).astype(bool))
+
+    i, out_vars, bufs = lax.while_loop(
+        cond_fn, body, (jnp.asarray(0), tuple(jvars), tuple(bufs)))
+    outs = [_wrap_like(b, loop_vars[0]) for b in bufs]
+    return (outs[0] if single else outs), _wrap_like(
+        list(out_vars), loop_vars)
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """lax.cond with the reference's thunk signature (contrib.cond)."""
+    p = jnp.asarray(_unwrap(pred)).reshape(()).astype(bool)
+    if inputs is None:
+        out = lax.cond(p, lambda _: _unwrap(then_func()),
+                       lambda _: _unwrap(else_func()), operand=0)
+        return _wrap_like(out, pred)
+    jin = tuple(_unwrap(inputs))
+    out = lax.cond(p, lambda xs: _unwrap(then_func(*xs)),
+                   lambda xs: _unwrap(else_func(*xs)), jin)
+    return _wrap_like(out, pred)

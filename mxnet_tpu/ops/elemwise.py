@@ -1,0 +1,164 @@
+"""Elementwise unary/binary ops.
+
+Reference parity: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_op_basic.cc, src/operator/mshadow_op.h (the functor zoo).
+All fuse trivially under XLA; nothing here needs Pallas.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _u(name, f, aliases=()):
+    register(name, aliases=aliases)(f)
+    return f
+
+
+# -- unary ---------------------------------------------------------------------
+_u("abs", jnp.abs)
+_u("sign", jnp.sign)
+_u("ceil", jnp.ceil)
+_u("floor", jnp.floor)
+_u("trunc", jnp.trunc)
+_u("rint", jnp.rint)
+_u("fix", jnp.trunc)
+_u("round", jnp.round)
+_u("exp", jnp.exp)
+_u("expm1", jnp.expm1)
+_u("log", jnp.log)
+_u("log2", jnp.log2)
+_u("log10", jnp.log10)
+_u("log1p", jnp.log1p)
+_u("sqrt", jnp.sqrt)
+_u("square", jnp.square)
+_u("cbrt", jnp.cbrt)
+_u("negative", jnp.negative)
+_u("sin", jnp.sin)
+_u("cos", jnp.cos)
+_u("tan", jnp.tan)
+_u("arcsin", jnp.arcsin)
+_u("arccos", jnp.arccos)
+_u("arctan", jnp.arctan)
+_u("sinh", jnp.sinh)
+_u("cosh", jnp.cosh)
+_u("tanh", jnp.tanh)
+_u("arcsinh", jnp.arcsinh)
+_u("arccosh", jnp.arccosh)
+_u("arctanh", jnp.arctanh)
+_u("degrees", jnp.degrees)
+_u("radians", jnp.radians)
+_u("erf", jax.scipy.special.erf)
+_u("erfinv", jax.scipy.special.erfinv)
+_u("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_u("gammaln", jax.scipy.special.gammaln)
+_u("logical_not", lambda x: jnp.logical_not(x).astype(jnp.float32))
+_u("isnan", jnp.isnan)
+_u("isinf", jnp.isinf)
+_u("isfinite", jnp.isfinite)
+
+
+@register("reciprocal")
+def reciprocal(data):
+    return 1.0 / data
+
+
+@register("rsqrt")
+def rsqrt(data):
+    return jax.lax.rsqrt(data)
+
+
+@register("rcbrt")
+def rcbrt(data):
+    return 1.0 / jnp.cbrt(data)
+
+
+@register("relu")
+def relu(data):
+    return jnp.maximum(data, 0)
+
+
+@register("sigmoid")
+def sigmoid(data):
+    return jax.nn.sigmoid(data)
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("softsign")
+def softsign(data):
+    return data / (1.0 + jnp.abs(data))
+
+
+@register("softrelu")
+def softrelu(data):
+    return jax.nn.softplus(data)
+
+
+@register("gelu")
+def gelu(data, approximate=True):
+    return jax.nn.gelu(data, approximate=approximate)
+
+
+@register("silu", aliases=("swish",))
+def silu(data):
+    return jax.nn.silu(data)
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+# -- binary (same-shape "elemwise_*" and broadcasting "broadcast_*") -----------
+# XLA broadcasts natively, so the elemwise_* and broadcast_* families share
+# implementations; the elemwise_* names are kept for reference-API parity.
+
+def _b(name, f, aliases=()):
+    register(name, aliases=aliases)(f)
+    return f
+
+
+_b("elemwise_add", jnp.add, aliases=("broadcast_add", "broadcast_plus", "add"))
+_b("elemwise_sub", jnp.subtract,
+   aliases=("broadcast_sub", "broadcast_minus", "subtract"))
+_b("elemwise_mul", jnp.multiply, aliases=("broadcast_mul", "multiply"))
+_b("elemwise_div", jnp.divide, aliases=("broadcast_div", "divide"))
+_b("broadcast_mod", jnp.mod, aliases=("mod",))
+_b("broadcast_power", jnp.power, aliases=("power", "pow"))
+_b("broadcast_maximum", jnp.maximum, aliases=("maximum",))
+_b("broadcast_minimum", jnp.minimum, aliases=("minimum",))
+_b("broadcast_hypot", jnp.hypot, aliases=("hypot",))
+
+
+def _cmp(f):
+    return lambda lhs, rhs: f(lhs, rhs).astype(jnp.float32)
+
+
+# Comparison ops return float32 0/1 masks, matching the reference
+# (src/operator/tensor/elemwise_binary_broadcast_op_logic.cc).
+_b("broadcast_equal", _cmp(jnp.equal), aliases=("equal",))
+_b("broadcast_not_equal", _cmp(jnp.not_equal), aliases=("not_equal",))
+_b("broadcast_greater", _cmp(jnp.greater), aliases=("greater",))
+_b("broadcast_greater_equal", _cmp(jnp.greater_equal),
+   aliases=("greater_equal",))
+_b("broadcast_lesser", _cmp(jnp.less), aliases=("lesser", "less"))
+_b("broadcast_lesser_equal", _cmp(jnp.less_equal),
+   aliases=("lesser_equal", "less_equal"))
+_b("broadcast_logical_and", _cmp(jnp.logical_and), aliases=("logical_and",))
+_b("broadcast_logical_or", _cmp(jnp.logical_or), aliases=("logical_or",))
+_b("broadcast_logical_xor", _cmp(jnp.logical_xor), aliases=("logical_xor",))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data,
+                     absd - 0.5 / s2)

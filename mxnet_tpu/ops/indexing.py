@@ -1,0 +1,136 @@
+"""Indexing / gather / scatter ops.
+
+Reference parity: src/operator/tensor/indexing_op.cc (take, Embedding,
+one_hot, gather_nd, scatter_nd, pick, batch_take).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import np_dtype
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[..., None], axis=-1
+    ).squeeze(-1)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("one_hot")
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jnp.asarray(
+        indices.astype(jnp.int32)[..., None] == jnp.arange(depth))
+    return jnp.where(oh, on_value, off_value).astype(np_dtype(dtype))
+
+
+@register("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    # Gather rows of the table; on TPU this is a dynamic-gather the compiler
+    # handles well.  sparse_grad is accepted for API parity (XLA's scatter-add
+    # transpose already gives the row-sparse-like update).
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("index_copy")
+def index_copy(old, index, new):
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("index_add")
+def index_add(old, index, new):
+    return old.at[index.astype(jnp.int32)].add(new)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # Dynamic-shape op in the reference (src/operator/contrib/boolean_mask.cc).
+    # XLA needs static shapes: we keep full size and compact valid rows to the
+    # front, returning (masked_data, valid_count)-style padded output is not
+    # API-compatible, so eager-only via host fallback.
+    import numpy as np
+
+    mask = np.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register("sequence_mask", aliases=("SequenceMask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # sequence axis is `axis` (0 or 1), batch is the other of the first two.
+    batch_axis = 1 - axis
+    mask = steps[:, None] < sequence_length[None, :]  # (seq, batch)
+    if axis == 1:
+        mask = mask.T
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    shape[batch_axis] = data.shape[batch_axis]
+    mask = mask.reshape(shape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [builtins_slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (seq, batch, ...)
+    batch = moved.shape[1]
+    return moved[last, jnp.arange(batch)]
+
+
+def builtins_slice(*a):
+    import builtins
+
+    return builtins.slice(*a)
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    seq = moved.shape[0]
+    steps = jnp.arange(seq)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)  # (seq, batch)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)

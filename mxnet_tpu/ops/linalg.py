@@ -1,0 +1,149 @@
+"""Linear-algebra ops.
+
+Reference parity: src/operator/tensor/la_op.cc (linalg_gemm, potrf, trsm,
+syrk, gelqf, syevd, ...) — mapped onto jax.numpy.linalg / lax.linalg, which
+lower to XLA's TPU-supported decompositions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    # inverse from cholesky factor: inv(L L^T)
+    L = A
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, lower=True, left_side=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    out = lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("linalg_gelqf")
+def linalg_gelqf(A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd")
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(A)
+    return out.at[..., idx - offset, idx].set(A)
+
+
+@register("linalg_inverse", aliases=("inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", aliases=("det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("slogdet",))
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("linalg_svd", aliases=("svd",))
+def linalg_svd(A):
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(A, offset=0, lower=True):
+    # invert extracttrian: packed vector -> triangular matrix with the same
+    # (offset, lower) convention (reference: src/operator/tensor/la_op.cc)
+    import numpy as _host_np
+
+    m = A.shape[-1]
+    k = int(offset)
+    n = 1
+    while len((_host_np.tril_indices(n, k) if lower
+               else _host_np.triu_indices(n, k))[0]) < m:
+        n += 1
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    rows, cols = (jnp.tril_indices(n, k) if lower
+                  else jnp.triu_indices(n, k))
+    return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    k = int(offset)
+    rows, cols = (jnp.tril_indices(n, k) if lower
+                  else jnp.triu_indices(n, k))
+    return A[..., rows, cols]

@@ -1,0 +1,281 @@
+"""Matrix / shape-manipulation ops.
+
+Reference parity: src/operator/tensor/matrix_op.cc (reshape/transpose/concat/
+slice/tile/pad/...), dot.cc (dot, batch_dot).  ``dot`` lowers to the MXU via
+lax.dot_general with a bfloat16-friendly preferred_element_type.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Reference dot semantics: reduce last axis of lhs with first of rhs
+    (after optional transposes) — N-D generalization included."""
+    if transpose_a:
+        lhs = jnp.moveaxis(lhs, 0, -1) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.moveaxis(rhs, -1, 0) if rhs.ndim > 1 else rhs
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False):
+    """Supports the reference's special codes 0 (keep), -1 (infer),
+    -2 (copy rest), -3 (merge two), -4 (split) — src/operator/tensor/
+    matrix_op-inl.h ReshapeShape."""
+    shape = tuple(int(s) for s in shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(data, shape)
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = tuple(reversed(shape))
+    out: list[int] = []
+    i = 0  # cursor into src
+    j = 0
+    shape_l = list(shape)
+    while j < len(shape_l):
+        s = shape_l[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape_l[j + 1], shape_l[j + 2]
+            if a == -1:
+                a = src[i] // b
+            elif b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        elif s == -1:
+            out.append(-1); i += 1
+        else:
+            out.append(s); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("flatten", aliases=("Flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("concat", aliases=("Concat",))
+def concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack")
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",))
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("slice")
+def slice(data, begin=None, end=None, step=None):  # noqa: A001
+    import builtins
+
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step or []) + [None] * (ndim - len(step or []))
+    idx = tuple(builtins.slice(b, e, s)
+                for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    import builtins
+
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    import builtins
+
+    idx = [builtins.slice(None)] * data.ndim
+    axes = axes or range(min(data.ndim, shape_like.ndim))
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    kw = {"constant_values": constant_value} if mode == "constant" else {}
+    return jnp.pad(data, pw, mode=jmode, **kw)
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=()):
+    return jnp.flip(data, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None):
+    shape = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axis = axis if isinstance(axis, (list, tuple)) else (axis,)
+    size = size if isinstance(size, (list, tuple)) else (size,)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like")
+def full_like(data, fill_value=0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("cast", aliases=("Cast",))
+def cast(data, dtype="float32"):
+    from ..base import np_dtype
+
+    return data.astype(np_dtype(dtype))
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float16"):
+    from ..base import np_dtype
+
+    return data.astype(np_dtype(dtype))
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+@register("diag")
+def diag(data, k=0):
+    return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(
+        data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("identity", aliases=("_copy", "copy"))
+def identity(data):
+    return data  # immutable arrays: copy is free
+
+
+@register("stop_gradient", aliases=("BlockGrad", "block_grad"))
+def stop_gradient(data):
+    return lax.stop_gradient(data)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=2):
+    b = block_size
+    n, c, h, w = data.shape
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=2):
+    b = block_size
+    n, c, h, w = data.shape
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)

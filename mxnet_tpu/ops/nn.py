@@ -1,0 +1,478 @@
+"""Neural-network ops.
+
+Reference parity: src/operator/nn/ (FullyConnected, Convolution, Pooling,
+BatchNorm, LayerNorm, Dropout, Activation, softmax family) — reimplemented on
+XLA primitives.  Convolutions keep the reference's NCHW/OIHW layout at the API
+surface; XLA relayouts for the MXU internally.  Train-mode statefulness
+(BatchNorm moving stats, Dropout masks) is functional here: stateful update
+lives in the gluon layers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# -- linear --------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """y = x W^T + b.  Weight layout (num_hidden, in) matches the reference
+    (src/operator/nn/fully_connected.cc).  The contraction is a single MXU
+    matmul; accumulate in f32 when inputs are bf16."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(
+        data, weight,
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# -- activations ---------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "erf": jax.scipy.special.erf,
+}
+
+
+@register("Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    return _ACTS[act_type](data)
+
+
+@register("LeakyReLU", aliases=("leaky_relu",))
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and data.ndim > 1:
+            shape = [1] * data.ndim
+            shape[1] = g.size if g.size > 1 else 1
+            g = g.reshape(shape) if g.size > 1 else g.reshape([1] * data.ndim)
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * (jnp.exp(data) - 1))
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type}")
+
+
+# -- softmax family ------------------------------------------------------------
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    if length is not None:
+        steps = jnp.arange(data.shape[axis])
+        shape = [1] * data.ndim
+        shape[axis] = data.shape[axis]
+        mask = steps.reshape(shape) < length.reshape(
+            [-1] + [1] * (data.ndim - 1))
+        data = jnp.where(mask, data, -jnp.inf)
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization, smooth_alpha):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        normalization, smooth_alpha):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, normalization,
+                        smooth_alpha, res, g):
+    # Reference semantics (src/operator/nn/softmax_output.cc): backward
+    # ignores the incoming gradient and emits grad_scale * (p - onehot(y)),
+    # optionally masking ignored labels, normalized per `normalization`
+    # ('null' = none, 'batch' = /batch, 'valid' = /non-ignored count).
+    out, label = res
+    classes = out.shape[-1]
+    ilabel = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(ilabel, classes, dtype=out.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / classes
+    grad = out - onehot
+    valid = None
+    if use_ignore:
+        mask = (ilabel != int(ignore_label)).astype(out.dtype)
+        grad = grad * mask[..., None]
+        valid = jnp.maximum(jnp.sum(mask), 1.0)
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid":
+        if valid is None:
+            valid = jnp.asarray(float(_np_prod(out.shape[:-1])))
+        grad = grad / valid
+    return grad_scale * grad, jnp.zeros_like(label)
+
+
+def _np_prod(shape):
+    p = 1
+    for s in shape:
+        p *= s
+    return p
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    return _softmax_output_core(data, label, float(grad_scale),
+                                int(ignore_label), bool(use_ignore),
+                                str(normalization), float(smooth_alpha))
+
+
+# -- convolution ---------------------------------------------------------------
+
+def _conv_dn(ndim):
+    if ndim == 3:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
+    """Grouped N-D convolution, NCHW/OIHW (reference layout).
+
+    XLA maps this to the MXU; bf16 inputs accumulate in f32 via
+    preferred_element_type (the TPU-native analog of cuDNN tensor-core math).
+    """
+    nd = data.ndim
+    spatial = nd - 2
+    stride = _pair(stride or 1, spatial)
+    dilate = _pair(dilate or 1, spatial)
+    pad = _pair(pad or 0, spatial)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, target_shape=None,
+                  num_filter=None, num_group=1, no_bias=True, workspace=512,
+                  cudnn_tune=None, cudnn_off=False, layout=None):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+    Weight layout (in, out/group, *k) as in the reference."""
+    nd = data.ndim
+    spatial = nd - 2
+    stride = _pair(stride or 1, spatial)
+    dilate = _pair(dilate or 1, spatial)
+    pad = _pair(pad or 0, spatial)
+    adj = _pair(adj or 0, spatial)
+    kshape = weight.shape[2:]
+    # conv_transpose padding that inverts a forward conv with `pad`:
+    padding = []
+    for k, p, a, d in zip(kshape, pad, adj, dilate):
+        keff = (k - 1) * d + 1
+        padding.append((keff - 1 - p, keff - 1 - p + a))
+    if num_group != 1:
+        groups_in = jnp.split(data, num_group, axis=1)
+        groups_w = jnp.split(weight, num_group, axis=0)
+        outs = [_deconv_one(x, w, stride, padding, dilate)
+                for x, w in zip(groups_in, groups_w)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv_one(data, weight, stride, padding, dilate)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _deconv_one(data, weight, stride, padding, dilate):
+    nd = data.ndim
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape, _conv_dn(nd))
+    # lhs_dilation implements the fractional stride of conv_transpose.
+    w = jnp.flip(weight, axis=tuple(range(2, nd)))
+    w = jnp.swapaxes(w, 0, 1)  # IO* -> OI* for the underlying conv
+    dn2 = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dn(nd))
+    return lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * (nd - 2),
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn2,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+
+
+# -- pooling -------------------------------------------------------------------
+
+@register("Pooling", aliases=("pooling",))
+def pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, cudnn_off=False, layout=None, p_value=2):
+    spatial = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            red = jnp.sum if pool_type == "sum" else jnp.mean
+            return red(data, axis=axes, keepdims=True)
+        if pool_type == "lp":
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(data), p_value), axis=axes,
+                        keepdims=True), 1.0 / p_value)
+    kernel = _pair(kernel, spatial)
+    stride = _pair(stride or 1, spatial)
+    pad = _pair(pad or 0, spatial)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad up so that ceil((x + 2p - k)/s) windows fit
+        padding = []
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+            x = data.shape[2 + i]
+            out = -(-(x + 2 * p - k) // s) + 1  # ceil division
+            needed = max((out - 1) * s + k - x - p, p)
+            padding.append((p, needed))
+    else:
+        padding = [(p, p) for p in pad]
+    padconf = [(0, 0), (0, 0)] + padding
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides,
+                                 padconf)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides,
+                                   padconf)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   padconf)
+        return summed / counts
+    if pool_type == "lp":
+        powed = lax.reduce_window(jnp.power(jnp.abs(data), p_value), 0.0,
+                                  lax.add, window, strides, padconf)
+        return jnp.power(powed, 1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# -- normalization -------------------------------------------------------------
+
+@register("BatchNorm", aliases=("batch_norm",), mode_dependent=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _is_training=True):
+    """Functional BatchNorm.  In train mode normalizes with batch statistics
+    and returns (out, batch_mean, batch_var) when output_mean_var — the gluon
+    layer owns the moving-average update (the reference mutates aux states
+    in-kernel, src/operator/nn/batch_norm.cc)."""
+    axes = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _is_training and not use_global_stats:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).reshape(shape)
+    out = (data - mean.reshape(shape)) * inv * g.reshape(shape) \
+        + beta.reshape(shape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("RMSNorm", aliases=("rms_norm",))
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return data * lax.rsqrt(ms + eps) * gamma.reshape(shape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    spatial = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+# -- dropout -------------------------------------------------------------------
+
+@register("Dropout", aliases=("dropout",), mode_dependent=True, random=True)
+def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False,
+            _is_training=True, _key=None):
+    if not _is_training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    keep = 1.0 - p
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1  # broadcast dropout along these axes
+    mask = jax.random.bernoulli(_key, keep, tuple(shape))
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# -- resize / upsample ---------------------------------------------------------
+
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(data, scale=2, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", workspace=512):
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+
+
+@register("BilinearResize2D", aliases=("bilinear_resize_2d",))
+def bilinear_resize_2d(data, like=None, height=1, width=1, scale_height=None,
+                       scale_width=None, mode="size", align_corners=True):
+    """Reference: src/operator/contrib/bilinear_resize.cc — mode selects how
+    the output size is derived (size / scale / odd_scale / like / to_even_*)."""
+    n, c, h, w = data.shape
+    if mode == "like" and like is not None:
+        height, width = like.shape[-2], like.shape[-1]
+    elif scale_height is not None:
+        sw = scale_width if scale_width is not None else scale_height
+        height, width = int(h * scale_height), int(w * sw)
+        if mode == "odd_scale":
+            height += (height + 1) % 2
+            width += (width + 1) % 2
+    elif mode == "to_even_down":
+        height, width = h - h % 2, w - w % 2
+    elif mode == "to_even_up":
+        height, width = h + h % 2, w + w % 2
+    elif mode == "to_odd_down":
+        height, width = h - (h + 1) % 2, w - (w + 1) % 2
+    elif mode == "to_odd_up":
+        height, width = h + (h + 1) % 2, w + (w + 1) % 2
+    return jax.image.resize(data, (n, c, height, width), "bilinear")
+
+
+# -- misc ----------------------------------------------------------------------
+
+@register("Custom", opaque=True)
+def custom(*data, op_type=None, **kwargs):
+    """Reference: src/operator/custom/custom.cc — python callback ops.
+    Dispatches to the CustomOp registry (mxnet_tpu.operator)."""
+    from .. import operator as custom_mod
+
+    return custom_mod._invoke_custom(op_type, data, kwargs)
+
+
+@register("Cast_storage", aliases=("cast_storage",))
+def cast_storage(data, stype="default"):
+    # Sparse storage types are represented densely on TPU (XLA has no sparse
+    # layout); kept for API parity.
+    return data

@@ -1,0 +1,130 @@
+"""Random sampling ops.
+
+Reference parity: src/operator/random/sample_op.cc (uniform/normal/gamma/
+exponential/poisson/negative_binomial samplers), multisample_op.cc,
+shuffle_op.cc.  All keyed on the functional PRNG (see mxnet_tpu.random).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+@register("random_uniform", aliases=("uniform",), random=True)
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", _key=None):
+    return jax.random.uniform(_key, _shape(shape), np_dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("random_normal", aliases=("normal",), random=True)
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", _key=None):
+    return loc + scale * jax.random.normal(_key, _shape(shape),
+                                           np_dtype(dtype))
+
+
+@register("random_gamma", aliases=("gamma_sample",), random=True)
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", _key=None):
+    return beta * jax.random.gamma(_key, alpha, _shape(shape),
+                                   np_dtype(dtype))
+
+
+@register("random_exponential", aliases=("exponential",), random=True)
+def random_exponential(lam=1.0, shape=None, dtype="float32", _key=None):
+    return jax.random.exponential(_key, _shape(shape), np_dtype(dtype)) / lam
+
+
+@register("random_poisson", aliases=("poisson",), random=True)
+def random_poisson(lam=1.0, shape=None, dtype="float32", _key=None):
+    return jax.random.poisson(_key, lam, _shape(shape)).astype(
+        np_dtype(dtype))
+
+
+@register("random_negative_binomial", aliases=("negative_binomial",),
+          random=True)
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
+                             _key=None):
+    k1, k2 = jax.random.split(_key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register("random_generalized_negative_binomial",
+          aliases=("generalized_negative_binomial",), random=True)
+def random_gnb(mu=1.0, alpha=1.0, shape=None, dtype="float32", _key=None):
+    k1, k2 = jax.random.split(_key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register("random_randint", aliases=("randint",), random=True)
+def random_randint(low=0, high=1, shape=None, dtype="int32", _key=None):
+    return jax.random.randint(_key, _shape(shape), low, high,
+                              np_dtype(dtype))
+
+
+@register("sample_multinomial", aliases=("multinomial",), random=True)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                       _key=None):
+    n = _shape(shape)
+    num = 1
+    for s in n:
+        num *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(_key, logits, shape=(num,) if n else ())
+        out = out.reshape(n) if n else out
+    else:
+        out = jax.random.categorical(_key, logits[:, None, :],
+                                     axis=-1, shape=(data.shape[0], num))
+        out = out.reshape((data.shape[0],) + n) if n else out[:, 0]
+    out = out.astype(np_dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1).reshape(-1, logits.shape[-1]),
+            out.reshape(data.shape[0] if data.ndim > 1 else 1, -1).astype(
+                jnp.int32),
+            axis=-1).reshape(out.shape)
+        return out, lp
+    return out
+
+
+@register("sample_uniform", random=True)
+def sample_uniform(low, high, shape=None, dtype="float32", _key=None):
+    s = _shape(shape)
+    u = jax.random.uniform(_key, low.shape + s, np_dtype(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register("sample_normal", random=True)
+def sample_normal(mu, sigma, shape=None, dtype="float32", _key=None):
+    s = _shape(shape)
+    z = jax.random.normal(_key, mu.shape + s, np_dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        sigma.reshape(sigma.shape + (1,) * len(s)) * z
+
+
+@register("shuffle", aliases=("random_shuffle",), random=True)
+def shuffle(data, _key=None):
+    return jax.random.permutation(_key, data, axis=0)
+
+
+@register("random_bernoulli", aliases=("bernoulli",), random=True)
+def random_bernoulli(p=0.5, shape=None, dtype="float32", _key=None):
+    return jax.random.bernoulli(_key, p, _shape(shape)).astype(
+        np_dtype(dtype))

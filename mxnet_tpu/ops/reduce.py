@@ -1,0 +1,122 @@
+"""Reduction / sorting / argmin-max ops.
+
+Reference parity: src/operator/tensor/broadcast_reduce_op_value.cc,
+ordering_op.cc (topk/sort/argsort), src/operator/tensor/matrix_op (norm).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(f):
+    def impl(data, axis=None, keepdims=False, exclude=False):
+        axis = _norm_axis(axis)
+        if exclude and axis is not None:
+            ax = axis if isinstance(axis, tuple) else (axis,)
+            ax = tuple(a % data.ndim for a in ax)
+            axis = tuple(i for i in range(data.ndim) if i not in ax)
+        return f(data, axis=axis, keepdims=keepdims)
+
+    return impl
+
+
+register("sum", aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("max", aliases=("max_axis",))(_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_reduce(jnp.min))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / n
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    x = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        x = -x
+    vals, idx = lax.top_k(x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(dtype)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ='mask'")
+    return idx
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype)
+
+
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def cumprod(a, axis=None, dtype=None):
+    return jnp.cumprod(a, axis=axis, dtype=dtype)

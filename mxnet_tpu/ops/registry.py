@@ -1,0 +1,79 @@
+"""Operator registry.
+
+Reference parity: the nnvm Op registry (NNVM_REGISTER_OP + FCompute/FGradient,
+reference: 3rdparty/nnvm include/nnvm/op.h, src/operator/**) and the
+import-time Python wrapper generation (python/mxnet/ndarray/register.py).
+
+TPU-first redesign: an op is a *pure JAX function* — shape/type inference,
+memory planning, kernel selection and fusion all belong to XLA, so the
+registry stores only the function plus frontend metadata.  Gradients come
+from JAX autodiff (``jax.vjp``), replacing the FGradient registry; ops that
+need custom gradients use ``jax.custom_vjp`` inside their implementation.
+
+Every registered op gets a generated NDArray-aware wrapper (see
+``mxnet_tpu.ndarray.register``).  Wrappers are polymorphic: called with
+NDArrays they run the eager path (unwrap → compute → wrap, recording on the
+autograd tape when active); called with jax arrays/tracers (e.g. inside a
+``hybridize()`` trace) they pass straight through to the pure function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ..base import MXNetError
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    aliases: tuple = ()
+    # Ops whose semantics depend on train vs predict mode (Dropout, BatchNorm):
+    # the wrapper injects _is_training from the autograd scope when unset.
+    mode_dependent: bool = False
+    # Ops that consume randomness: the wrapper injects a PRNG key kwarg
+    # (named _key) from the global/random key scope when unset.
+    random: bool = False
+    # Opaque ops run on NDArrays directly (host-level, own tape handling —
+    # e.g. Custom); the invoke layer must not unwrap or jax.vjp them.
+    opaque: bool = False
+
+
+_OPS: dict[str, OpDef] = {}
+
+
+def register(name: str | None = None, aliases: tuple = (),
+             mode_dependent: bool = False, random: bool = False,
+             opaque: bool = False):
+    """Decorator registering a pure-JAX op under its reference name."""
+
+    def _do(fn):
+        opname = name or fn.__name__
+        opdef = OpDef(opname, fn, tuple(aliases), mode_dependent, random,
+                      opaque)
+        if opname in _OPS:
+            raise MXNetError(f"op {opname!r} registered twice")
+        _OPS[opname] = opdef
+        for a in opdef.aliases:
+            _OPS.setdefault(a, OpDef(a, fn, (), mode_dependent, random,
+                                     opaque))
+        return fn
+
+    return _do
+
+
+def get(name: str) -> OpDef:
+    if name not in _OPS:
+        raise MXNetError(f"op {name!r} not registered")
+    return _OPS[name]
+
+
+def list_ops() -> list[str]:
+    """All registered op names (reference: MXListAllOpNames)."""
+    return sorted(_OPS)
+
+
+def all_ops() -> dict[str, OpDef]:
+    return dict(_OPS)

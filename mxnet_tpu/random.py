@@ -1,0 +1,83 @@
+"""PRNG management.
+
+Reference parity: src/resource.cc (kRandom/kParallelRandom resources),
+python/mxnet/random.py (mx.random.seed).
+
+TPU-first design: JAX's counter-based threefry PRNG replaces the reference's
+per-device RNG states.  Eager ops draw keys from a global stateful key chain
+(split-per-call, like the reference's global RandomGenerator); traced code
+(hybridized blocks / jitted steps) draws from a *key scope* — a thread-local
+stack established by the CachedOp with a key that is an argument of the jit,
+so randomness is functional under compilation and refreshes per invocation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+
+class _KeyState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.scope: list = []  # (key, counter-box) entries
+
+
+_STATE = _KeyState()
+
+
+def seed(seed_state: int, ctx="all") -> None:
+    """mx.random.seed — reseeds the global eager key chain."""
+    import jax
+
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def _global_key():
+    import jax
+
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(
+            int(_np.random.SeedSequence().entropy % (2 ** 31)))
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+class key_scope:
+    """Context manager routing `next_key()` to folds of a base key.
+
+    The base key may be a tracer (CachedOp passes its per-call key argument),
+    which makes every random op inside a trace a pure function of that key.
+    """
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+
+    def __enter__(self):
+        _STATE.scope.append([self.base_key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.scope.pop()
+
+
+def next_key():
+    """Fetch a fresh PRNG key: scope-folded if inside a key_scope (traceable),
+    else split from the global chain (eager)."""
+    import jax
+
+    if _STATE.scope:
+        entry = _STATE.scope[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    return _global_key()
+
+
+def in_key_scope() -> bool:
+    return bool(_STATE.scope)
+
+
+# numpy-compatible helpers used across the frontend
+def np_seed(seed_state):
+    _np.random.seed(seed_state)

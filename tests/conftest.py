@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): tests run on a virtual
+8-device CPU mesh so multi-chip sharding paths execute without TPU hardware —
+the analog of the reference's local dmlc tracker for fake multi-node
+(tests/nightly run via `tools/launch.py --launcher local`).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Reference: @with_seed() in tests/python/unittest/common.py —
+    deterministic seeds per test, logged for replay on failure."""
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

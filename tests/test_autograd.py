@@ -1,0 +1,182 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2 + x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()) + 1,
+                       rtol=1e-5)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy() + 1)
+    assert np.allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_dot_grad():
+    a = nd.array(np.random.rand(2, 3).astype(np.float32))
+    w = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = nd.dot(a, w)
+        loss = nd.sum(out)
+    loss.backward()
+    expected = a.asnumpy().T @ np.ones((2, 4), np.float32)
+    assert np.allclose(w.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_grad_req_add():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 3 * 2 * 2.0)
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 4.0)  # only d(y_detached * x)/dx
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 1.0)
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * 10
+        z = x * 2
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_recording_flags():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_getitem_grad():
+    x = nd.array([1.0, 2.0, 3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x[1:3] * 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0, 2, 2, 0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert np.allclose(g.asnumpy(), 12.0)
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 1.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = nd.sum(x * 5)
+    y.backward()
+    assert np.allclose(g.asnumpy(), 5.0)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self._saved
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=1)
+        loss = nd.sum(a) + nd.sum(b * 2)
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert np.allclose(g[:, :3], 1) and np.allclose(g[:, 3:], 2)
+
+
+def test_softmax_output_custom_grad():
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="int32")
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label.astype("float32"))
+    out.backward()
+    p = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    # reference default normalization='null': grad is p - onehot, unscaled
+    assert np.allclose(x.grad.asnumpy(), p - onehot, rtol=1e-4, atol=1e-5)
