@@ -237,6 +237,25 @@ class NDArray:
     def __dlpack__(self, **kwargs):
         return self._data.__dlpack__(**kwargs)
 
+    # pickle via host numpy (reference NDArrays pickle through save/load
+    # bytes; used by Updater.get_states / DataLoader workers)
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "stype": self._stype}
+
+    def __setstate__(self, state):
+        import jax.numpy as jnp
+
+        self._data = jnp.asarray(state["data"])
+        self._ctx = None
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._stype = state.get("stype", "default")
+
+    def __reduce__(self):
+        return (_unpickle_ndarray, (self.asnumpy(), self._stype))
+
     # NDArray equality is elementwise (reference semantics) → unhashable.
     __hash__ = None  # type: ignore
 
@@ -377,3 +396,9 @@ def _unwrap_index(key):
 
 def _from_jax(arr, ctx=None) -> NDArray:
     return NDArray(arr, ctx)
+
+
+def _unpickle_ndarray(np_data, stype):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.asarray(np_data), stype=stype)

@@ -16,6 +16,7 @@ from . import nn
 from . import random_ops
 from . import linalg
 from . import control_flow
+from . import optimizer_op
 
 # Re-export every registered pure function at module level so that
 # `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.

@@ -1,0 +1,12 @@
+"""Optimizer API (reference: python/mxnet/optimizer/)."""
+
+from . import optimizer
+from . import lr_scheduler
+from .optimizer import (Optimizer, SGD, NAG, Adam, Adamax, Nadam, RMSProp,
+                        AdaGrad, AdaDelta, Ftrl, Signum, SGLD, DCASGD, LAMB,
+                        AdamW, Test, Updater, get_updater, register, create)
+from .lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
+                           PolyScheduler, CosineScheduler)
+
+# reference alias: mx.optimizer.ccSGD etc. are deprecated; keep `create`
+# as the canonical factory (mx.optimizer.create / Optimizer.create_optimizer)

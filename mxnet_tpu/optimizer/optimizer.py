@@ -1,0 +1,741 @@
+"""Optimizer registry and built-in optimizers.
+
+Reference parity: python/mxnet/optimizer/optimizer.py — the ``Optimizer``
+base (registry, lr/wd multipliers, update counting, multi-precision) and the
+built-ins: SGD, NAG, Adam, Adamax, Nadam, RMSProp, AdaGrad, AdaDelta, Ftrl,
+Signum, SGLD, DCASGD, LAMB, plus ``Updater``/``get_updater`` (the KVStore
+server-side update path).
+
+TPU-first: every update dispatches to a fused pure-JAX op
+(ops/optimizer_op.py) — a single XLA elementwise fusion per parameter —
+and mutates the weight/state NDArrays by handle swap.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _from_jax
+from ..ops import optimizer_op as _op
+from . import lr_scheduler as lr_scheduler_mod
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class Optimizer:
+    """Base class for optimizers (reference: mx.optimizer.Optimizer)."""
+
+    opt_registry: dict = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise ValueError("param_idx2name should be a dict of param "
+                             "indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s.%s is overriding "
+                            "existing optimizer %s.%s", klass.__module__,
+                            klass.__name__,
+                            Optimizer.opt_registry[name].__module__,
+                            Optimizer.opt_registry[name].__name__)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy, self.create_state(index,
+                                                          weight_master_copy))
+        if weight.dtype == _np.float16 and not self.multi_precision:
+            logging.warning("Accumulating with float16 in optimizer can lead "
+                            "to poor accuracy or slow convergence. Consider "
+                            "using multi_precision=True option of the "
+                            "optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight._set_data(weight_master_copy._data.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret["param_dict"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        self.param_dict = {}
+
+    # common kwargs passed to every fused op
+    def _common(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def _apply(self, pure_fn, weight, states, grad, **kwargs):
+        """Run a fused pure update; swap results into weight/state handles."""
+        res = pure_fn(_raw(weight), _raw(grad),
+                      *[_raw(s) for s in states], **kwargs)
+        weight._set_data(res[0])
+        for s, new in zip(states, res[1:]):
+            s._set_data(new)
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: sgd_update / sgd_mom_update /
+    mp_sgd_* kernels, src/operator/optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common()
+        if state is not None:
+            self._apply(_op.sgd_mom_update_pure, weight, [state], grad,
+                        lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            self._apply(_op.sgd_update_pure, weight, [], grad, lr=lr, wd=wd,
+                        **kw)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: nag_mom_update)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common()
+        if state is not None:
+            self._apply(_op.nag_mom_update_pure, weight, [state], grad,
+                        lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            self._apply(_op.sgd_update_pure, weight, [], grad, lr=lr, wd=wd,
+                        **kw)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: adam_update kernel; bias correction folded into lr
+    exactly as python/mxnet/optimizer/optimizer.py does)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        self._apply(_op.adam_update_pure, weight, [mean, var], grad, lr=lr,
+                    wd=wd, beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, **self._common())
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax — infinity-norm Adam variant (reference: Adamax python impl)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        m_t, u_t = state
+        g = _raw(grad) * self.rescale_grad + wd * _raw(weight)
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_m = self.beta1 * _raw(m_t) + (1.0 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * _raw(u_t), jnp.abs(g))
+        m_t._set_data(new_m)
+        u_t._set_data(new_u)
+        weight._set_data(_raw(weight) - lr * new_m / (new_u + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: Nadam python impl)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = _raw(grad) * self.rescale_grad + wd * _raw(weight)
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t *
+                                                        self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        g_prime = g / (1.0 - self.m_schedule)
+        new_m = self.beta1 * _raw(m_t) + (1.0 - self.beta1) * g
+        new_v = self.beta2 * _raw(v_t) + (1.0 - self.beta2) * g * g
+        m_t_prime = new_m / (1.0 - m_schedule_next)
+        v_t_prime = new_v / (1.0 - self.beta2 ** t)
+        m_t_bar = ((1.0 - momentum_t) * g_prime
+                   + momentum_t_1 * m_t_prime)
+        m_t._set_data(new_m)
+        v_t._set_data(new_v)
+        weight._set_data(_raw(weight) - lr * m_t_bar
+                         / (jnp.sqrt(v_t_prime) + self.epsilon))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered or not (reference: rmsprop_update /
+    rmspropalex_update kernels)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        z = lambda: _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+        if self.centered:
+            return (z(), z(), z())  # n, g, delta
+        return (z(),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            self._apply(_op.rmspropalex_update_pure, weight, [n, g, delta],
+                        grad, lr=lr, wd=wd, gamma1=self.gamma1,
+                        gamma2=self.gamma2, epsilon=self.epsilon, **kw)
+        else:
+            (n,) = state
+            self._apply(_op.rmsprop_update_pure, weight, [n], grad, lr=lr,
+                        wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+                        **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: AdaGrad python impl over _internal ops)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._apply(_op.adagrad_update_pure, weight, [state], grad, lr=lr,
+                    wd=wd, epsilon=self.float_stable_eps, **self._common())
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: AdaDelta python impl)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        self._apply(_op.adadelta_update_pure, weight, [acc_g, acc_delta],
+                    grad, rho=self.rho, epsilon=self.epsilon, wd=wd,
+                    **self._common())
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: ftrl_update kernel)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        self._apply(_op.ftrl_update_pure, weight, [z, n], grad, lr=lr,
+                    wd=wd, lamda1=self.lamda1, beta=self.beta,
+                    **self._common())
+
+
+@register
+class Signum(Optimizer):
+    """Signum / SignSGD (reference: signum_update / signsgd_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common()
+        if state is not None:
+            self._apply(_op.signum_update_pure, weight, [state], grad,
+                        lr=lr, wd=wd, momentum=self.momentum,
+                        wd_lh=self.wd_lh, **kw)
+        else:
+            self._apply(_op.signsgd_update_pure, weight, [], grad, lr=lr,
+                        wd=wd, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: SGLD python impl)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        import jax
+        import jax.numpy as jnp
+
+        from ..random import next_key
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _raw(grad) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = jax.random.normal(next_key(), weight.shape,
+                                  dtype=_raw(weight).dtype) * math.sqrt(lr)
+        weight._set_data(_raw(weight) - lr / 2 * (g + wd * _raw(weight))
+                         + noise)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: DCASGD python impl)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = _raw(grad) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        w = _raw(weight)
+        pw = _raw(previous_weight)
+        comp = g + wd * w + self.lamda * g * g * (w - pw)
+        if mom is not None:
+            new_mom = self.momentum * _raw(mom) - lr * comp
+            mom._set_data(new_mom)
+            delta = new_mom
+        else:
+            delta = -lr * comp
+        previous_weight._set_data(w)
+        weight._set_data(w + delta)
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB layerwise-adaptive large-batch optimizer (reference:
+    lamb_update_phase1/2 kernels, ≥1.6)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g, new_mean, new_var = _op.lamb_update_phase1_pure(
+            _raw(weight), _raw(grad), _raw(mean), _raw(var), t=t,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            wd=wd, bias_correction=self.bias_correction, **self._common())
+        mean._set_data(new_mean)
+        var._set_data(new_var)
+        r1 = jnp.linalg.norm(_raw(weight))
+        r2 = jnp.linalg.norm(g)
+        kw = {}
+        if self.lower_bound is not None:
+            kw["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw["upper_bound"] = self.upper_bound
+        (new_w,) = _op.lamb_update_phase2_pure(_raw(weight), g, r1, r2,
+                                               lr=lr, **kw)
+        weight._set_data(new_w)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference: contrib.AdamW)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return (_from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)),
+                _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        self._apply(_op.adamw_update_pure, weight, [mean, var], grad,
+                    lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, **self._common())
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer (reference: mx.optimizer.Test) — w -= lr*grad only."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(_raw(weight)
+                         - self.lr * self.rescale_grad * _raw(grad))
+
+
+class Updater:
+    """Applies an Optimizer to (index, grad, weight) triples, owning states
+    (reference: mx.optimizer.Updater — the local/server-side update path)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def sync_state_context(self, state, context):
+        return state
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,135 @@
+"""Metric + initializer tests (reference: tests/python/unittest/test_metric.py
+and test_init.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(2.0 / 3.0)
+
+
+def test_topk():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 1, 1])
+    m.update([label], [pred])
+    # tp=2 fp=0 fn=1 → p=1, r=2/3 → f1=0.8
+    assert m.get()[1] == pytest.approx(0.8)
+
+
+def test_mse_mae_rmse():
+    label = mx.nd.array([1.0, 2.0, 3.0])
+    pred = mx.nd.array([1.0, 2.0, 5.0])
+    for name, exp in [("mse", 4.0 / 3), ("mae", 2.0 / 3),
+                      ("rmse", np.sqrt(4.0 / 3))]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(exp)
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    exp = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(exp)
+
+
+def test_composite_and_custom():
+    m = metric.create(["acc", "mse"])
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    m.get_metric(0).update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names
+
+    def my_metric(label, pred):
+        return float(np.sum(pred))
+    cm = metric.create(my_metric)
+    cm.update([label], [pred])
+    assert cm.get()[1] == pytest.approx(1.0)
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, [mx.nd.array([1.0, 2.0, 3.0])])
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_initializers_shapes_and_stats():
+    mx.random.seed(42)
+    np.random.seed(42)
+    arr = mx.nd.zeros((64, 32))
+    init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2)(
+        init.InitDesc("fc_weight"), arr)
+    std = arr.asnumpy().std()
+    assert std == pytest.approx(np.sqrt(2.0 / 32), rel=0.2)
+
+    arr2 = mx.nd.zeros((10,))
+    init.Uniform(0.5)(init.InitDesc("x_weight"), arr2)
+    assert np.abs(arr2.asnumpy()).max() <= 0.5
+
+    arr3 = mx.nd.zeros((8, 8))
+    init.Orthogonal()(init.InitDesc("q_weight"), arr3)
+    a = arr3.asnumpy() / 1.414
+    np.testing.assert_allclose(a @ a.T, np.eye(8), atol=1e-5)
+
+
+def test_initializer_name_dispatch():
+    ini = init.Xavier()
+    bias = mx.nd.ones((4,))
+    ini(init.InitDesc("fc1_bias"), bias)
+    np.testing.assert_allclose(bias.asnumpy(), np.zeros(4))
+    gamma = mx.nd.zeros((4,))
+    ini(init.InitDesc("bn_gamma"), gamma)
+    np.testing.assert_allclose(gamma.asnumpy(), np.ones(4))
+
+
+def test_constant_and_mixed():
+    arr = mx.nd.zeros((3, 3))
+    init.Constant(2.5)(init.InitDesc("c_weight"), arr)
+    np.testing.assert_allclose(arr.asnumpy(), 2.5 * np.ones((3, 3)))
+    mixed = init.Mixed([".*fc2.*", ".*"], [init.One(), init.Constant(3.0)])
+    b = mx.nd.zeros((2,))
+    mixed(init.InitDesc("fc2_weight"), b)
+    np.testing.assert_allclose(b.asnumpy(), np.ones(2))
+    c = mx.nd.zeros((2,))
+    mixed(init.InitDesc("fc1_weight"), c)
+    np.testing.assert_allclose(c.asnumpy(), 3.0 * np.ones(2))
+
+
+def test_initializer_dumps_create_roundtrip():
+    ini = init.Xavier(rnd_type="gaussian", magnitude=2)
+    import json
+
+    name, kwargs = json.loads(ini.dumps())
+    ini2 = init.create(name, **kwargs)
+    assert ini == ini2
+
+
+def test_lstmbias():
+    arr = mx.nd.ones((8,))
+    init.LSTMBias(forget_bias=1.0)(init.InitDesc("lstm_bias"), arr)
+    out = arr.asnumpy()
+    np.testing.assert_allclose(out[2:4], np.ones(2))
+    np.testing.assert_allclose(out[:2], np.zeros(2))
