@@ -1,0 +1,24 @@
+"""Gluon: the imperative/hybrid frontend (reference: python/mxnet/gluon/)."""
+
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from .parameter import DeferredInitializationError
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import utils
+from . import trainer
+from .trainer import Trainer
+
+# subpackages that land in later milestones are imported lazily so the core
+# stays importable while they are being built
+import importlib as _importlib
+
+for _mod in ("rnn", "data", "model_zoo", "contrib"):
+    try:
+        globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
+    except ModuleNotFoundError as _e:
+        if _e.name != f"{__name__}.{_mod}":
+            raise
+del _importlib, _mod

@@ -1,0 +1,9 @@
+"""Gluon data API (reference: python/mxnet/gluon/data/)."""
+
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset,
+                      SimpleDataset)
+from .sampler import (BatchSampler, FilterSampler, RandomSampler, Sampler,
+                      SequentialSampler)
+from .dataloader import (DataLoader, default_batchify_fn,
+                         default_mp_batchify_fn)
+from . import vision

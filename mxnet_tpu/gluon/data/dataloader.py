@@ -1,0 +1,189 @@
+"""DataLoader.
+
+Reference parity: python/mxnet/gluon/data/dataloader.py — DataLoader with
+batchify (default_batchify_fn), samplers, and multi-worker loading.
+
+TPU-first note: the reference uses multiprocessing workers with shared-memory
+NDArrays.  Host-side decode/augment here uses a thread pool by default
+(numpy/PIL release the GIL for the heavy parts, and threads avoid
+re-importing jax per worker); ``thread_pool=False`` with num_workers>0 uses
+processes with pickled numpy batches.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import multiprocessing.pool
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, _from_jax
+from . import sampler as _sampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    import jax.numpy as jnp
+
+    return _from_jax(jnp.asarray(data))
+
+
+def default_mp_batchify_fn(data):
+    """Batchify in a worker: keep numpy (cheap pickling), wrap in parent."""
+    if isinstance(data[0], NDArray):
+        return _np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return _np.asarray(data)
+
+
+def _as_in_context(data, ctx):
+    if isinstance(data, NDArray):
+        return data.as_in_context(ctx)
+    if isinstance(data, (list, tuple)):
+        return [_as_in_context(d, ctx) for d in data]
+    return data
+
+
+class _Worker:
+    """Picklable per-batch fetch closure for pool workers."""
+
+    def __init__(self, dataset, batchify_fn):
+        self._dataset = dataset
+        self._batchify_fn = batchify_fn
+
+    def __call__(self, samples):
+        return self._batchify_fn([self._dataset[i] for i in samples])
+
+
+class DataLoader:
+    """Loads mini-batches from a Dataset (reference: gluon.data.DataLoader).
+
+    Parameters follow the reference: dataset, batch_size, shuffle, sampler,
+    last_batch ('keep'|'discard'|'rollover'), batch_sampler, batchify_fn,
+    num_workers, pin_memory (ignored: XLA host buffers are already pinned),
+    prefetch, thread_pool.
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=True, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = _sampler.RandomSampler(len(dataset))
+                else:
+                    sampler = _sampler.SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = _sampler.BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            if num_workers > 0 and not thread_pool:
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    ret = self._batchify_fn(
+                        [self._dataset[idx] for idx in batch])
+                    yield ret
+            return same_process_iter()
+        return _MultiWorkerIter(self)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+
+class _MultiWorkerIter:
+    """Pool-based prefetching iterator."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._worker = _Worker(loader._dataset, loader._batchify_fn)
+        if loader._thread_pool:
+            self._pool = ThreadPoolExecutor(
+                max_workers=loader._num_workers)
+            self._submit = self._pool.submit
+        else:
+            self._mp_pool = multiprocessing.get_context("spawn").Pool(
+                loader._num_workers)
+            self._submit = lambda fn, arg: self._mp_pool.apply_async(fn,
+                                                                     (arg,))
+        self._batches = iter(loader._batch_sampler)
+        self._pending = []
+        self._done = False
+        for _ in range(max(1, loader._prefetch)):
+            self._push_next()
+
+    def _push_next(self):
+        batch = next(self._batches, None)
+        if batch is None:
+            return
+        self._pending.append(self._submit(self._worker, batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._pending:
+            self._shutdown()
+            raise StopIteration
+        fut = self._pending.pop(0)
+        self._push_next()
+        if hasattr(fut, "result"):
+            out = fut.result(timeout=self._loader._timeout)
+        else:
+            out = fut.get(timeout=self._loader._timeout)
+        if isinstance(out, _np.ndarray) or (
+                isinstance(out, list)
+                and out and isinstance(out[0], _np.ndarray)):
+            # mp path returns numpy; wrap on the parent process
+            import jax.numpy as jnp
+
+            if isinstance(out, list):
+                return [_from_jax(jnp.asarray(o)) for o in out]
+            return _from_jax(jnp.asarray(out))
+        return out
+
+    def _shutdown(self):
+        if hasattr(self, "_pool"):
+            self._pool.shutdown(wait=False)
+        if hasattr(self, "_mp_pool"):
+            self._mp_pool.terminate()

@@ -1,0 +1,5 @@
+"""Vision data (reference: python/mxnet/gluon/data/vision/)."""
+
+from .datasets import (CIFAR10, CIFAR100, FashionMNIST, ImageFolderDataset,
+                       ImageRecordDataset, MNIST)
+from . import transforms

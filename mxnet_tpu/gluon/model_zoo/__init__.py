@@ -1,0 +1,4 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo/)."""
+
+from . import vision
+from .model_store import get_model_file, purge

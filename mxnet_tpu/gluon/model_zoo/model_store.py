@@ -1,0 +1,41 @@
+"""Pretrained-weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+Zero-egress environment: weights resolve only from the local root
+(~/.mxnet/models); a missing file is a clear error instead of a download.
+Files saved by the reference (`.params`, the NDArray container format) load
+directly — the serialization layer is byte-compatible.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Locate a pretrained parameter file locally."""
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, f"{name}.params")
+    if os.path.exists(file_path):
+        return file_path
+    candidates = []
+    if os.path.isdir(root):
+        candidates = [f for f in os.listdir(root)
+                      if f.startswith(name) and f.endswith(".params")]
+    if candidates:
+        return os.path.join(root, sorted(candidates)[-1])
+    raise MXNetError(
+        f"Pretrained model file for '{name}' not found under {root}. This "
+        "environment has no network access; place the .params file there "
+        "manually (reference-format files are compatible).")
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

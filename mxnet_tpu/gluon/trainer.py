@@ -1,0 +1,191 @@
+"""Gluon Trainer.
+
+Reference parity: python/mxnet/gluon/trainer.py — Trainer(params, optimizer,
+optimizer_params, kvstore, update_on_kvstore), step/allreduce_grads/update,
+learning-rate control, optimizer-state save/load.
+
+TPU-first: with one logical array per parameter, `allreduce_grads` is the
+cross-process reduce (kvstore dist types → ICI/DCN all-reduce); the
+single-chip path applies fused optimizer ops directly.  For whole-step
+compilation (grad + reduce + update in ONE XLA program) see
+mxnet_tpu.parallel.DataParallelTrainer, this class's jit-native sibling.
+"""
+
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._contains_sparse_weight = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        kv = None
+        if kvstore:
+            from .. import kvstore as kvs
+
+            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+            if kv.num_workers == 1 and not kvstore_requires_store(kv):
+                kv = None  # single worker: local fused update path
+        if kv is not None:
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                if param._grad_req != "null":
+                    kv.init(i, param.data())
+        self._kvstore = kv
+        self._update_on_kvstore = bool(update_on_kvstore) and kv is not None
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate can be accessed.")
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate is mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce_grads + update (reference: Trainer.step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise AssertionError(
+                "allreduce_grads() when parameters are updated on kvstore "
+                "is not supported. Try setting `update_on_kvstore` to False "
+                "when creating trainer.")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param._grad_req != "null":
+                if self._update_on_kvstore:
+                    # push grad; pull updated weight (server-side optimizer)
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                else:
+                    self._kvstore.pushpull(i, param.list_grad(),
+                                           out=param.list_grad(),
+                                           priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param._grad_req == "null":
+                continue
+            if not ignore_stale_grad:
+                data = param.data()
+                if hasattr(data, "_fresh_grad") and not data._fresh_grad:
+                    raise UserWarning(
+                        f"Gradient of Parameter `{param.name}` on context "
+                        "has not been updated by backward since last step.")
+            if self._update_on_kvstore:
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+            else:
+                self._updaters[0](i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (reference: Trainer.save_states)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(
+                    dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            self._updaters[0].set_states(states)
+            self._updaters[0].optimizer = self._optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}
+
+
+def kvstore_requires_store(kv):
+    """dist types always go through the store (cross-process reduce)."""
+    return kv.type.startswith("dist")

@@ -1,0 +1,151 @@
+"""Gluon utilities.
+
+Reference parity: python/mxnet/gluon/utils.py — split_data / split_and_load
+(the data-parallel primitive), clip_global_norm, check_sha1, download.
+
+TPU-first note: ``split_and_load`` with a list of contexts keeps the
+reference API for per-device slices, but the idiomatic multi-chip path is a
+*sharded* batch — pass ``even_split='shard'`` sentinel or use
+``mxnet_tpu.parallel`` to lay the global batch over the mesh data axis and
+let XLA move the shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..ndarray.ndarray import NDArray, _from_jax
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice slices (reference:
+    utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's multiple of {num_slice} or set even_split=False to "
+            "allow uneven partitioning of data.")
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
+                  else data[i * step:size] for i in range(num_slice)]
+    else:
+        from .. import ndarray as nd
+
+        slices = [nd.slice_axis(data, batch_axis, i * step, (i + 1) * step)
+                  if i < num_slice - 1
+                  else nd.slice_axis(data, batch_axis, i * step, size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load slices onto ctx_list (reference:
+    utils.split_and_load)."""
+    if not isinstance(data, NDArray):
+        from .. import ndarray as nd
+
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the 2-norm of the concatenation is at most
+    max_norm (reference: utils.clip_global_norm)."""
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return (x * x).sum()
+        return array.norm().square()
+
+    assert len(arrays) > 0, "arrays must not be empty"
+    ctx = arrays[0].context
+    total_norm = sum(_norm(arr).as_in_context(ctx) for arr in arrays)
+    total_norm = total_norm.sqrt()
+    if check_isfinite:
+        total_norm_val = float(total_norm.asscalar())
+        if not _np.isfinite(total_norm_val):
+            import warnings
+
+            warnings.warn(UserWarning("nan or inf is detected. Clipping "
+                                      "results will be undefined."),
+                          stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    from .. import ndarray as nd
+
+    scale = nd.minimum(scale, nd.ones_like(scale))
+    for arr in arrays:
+        arr *= scale
+    if check_isfinite:
+        return total_norm_val
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check a file against an expected sha1 (reference: utils.check_sha1)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference: utils.download.  This build runs with zero egress; only
+    file:// URLs and already-present files are supported."""
+    if path is None:
+        fname = url.split("/")[-1]
+        path = fname
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+        path = fname
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise MXNetError(
+        f"download of {url} requires network access, which is unavailable "
+        "in this environment. Place the file at {fname} manually.")
+
+
+def _indent(s_, numSpaces):
+    """Indent string (reference: utils._indent)."""
+    s = s_.split("\n")
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(numSpaces * " ") + line for line in s]
+    return "\n".join(s)
+
+
+def shape_is_known(shape):
+    """True iff shape is fully known (no 0 dims)."""
+    if shape is None:
+        return False
+    for dim_size in shape:
+        if dim_size == 0:
+            return False
+    return True

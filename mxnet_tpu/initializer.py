@@ -80,6 +80,16 @@ class Initializer:
     def __call__(self, desc, arr):
         if not isinstance(desc, str):
             raise TypeError("desc must be an InitDesc or string")
+        if isinstance(desc, InitDesc):
+            if desc.global_init is None:
+                desc.global_init = self
+            # a per-parameter init (Parameter(init=...)) overrides suffix
+            # dispatch (reference: attrs['__init__'] handling)
+            init = desc.attrs.get("__init__", "")
+            if init:
+                create(init)._init_weight(desc, arr)
+                self._verbose_print(desc, str(init), arr)
+                return
         if desc.endswith("weight"):
             self._init_weight(desc, arr)
         elif desc.endswith("bias"):
@@ -182,6 +192,11 @@ class One(Initializer):
 
     def _init_weight(self, _, arr):
         self._set(arr, _np.ones(arr.shape))
+
+
+# reference alias names (mx.init registry: @register(alias=...))
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
 
 
 @register
@@ -345,15 +360,26 @@ class FusedRNN(Initializer):
 
     def _init_weight(self, desc, arr):
         # packed single-vector parameter: init as a whole via the wrapped
-        # initializer, then set LSTM forget biases
+        # initializer, then overwrite LSTM forget-gate biases.  Packing
+        # (ops/rnn.py): all (Wx, Wh) pairs layer/direction-major, then all
+        # (bx, bh) pairs; LSTM gate order i f g o → forget slice [H, 2H).
         if self._init is not None:
             self._init._init_weight(desc, arr)
-        if self._mode == "lstm":
-            a = arr.asnumpy()
-            # bias layout: per layer/direction, [i f c o] gates × hidden
-            # biases live in the trailing region; simple heuristic matching
-            # the rnn op's packing (ops/rnn.py)
-            self._set(arr, a)
+        if self._mode != "lstm":
+            return
+        a = arr.asnumpy().copy()
+        h = self._num_hidden
+        dirs = 2 if self._bidirectional else 1
+        gates = 4
+        bias_start = a.size - self._num_layers * dirs * 2 * gates * h
+        off = bias_start
+        for _layer in range(self._num_layers):
+            for _d in range(dirs):
+                a[off + h:off + 2 * h] = self._forget_bias  # bx forget
+                off += gates * h
+                a[off + h:off + 2 * h] = 0.0                # bh forget
+                off += gates * h
+        self._set(arr, a)
 
 
 class Mixed:

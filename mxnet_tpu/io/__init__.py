@@ -1,0 +1,5 @@
+"""Data iterators (reference: python/mxnet/io/ + src/io/)."""
+
+from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
+                 NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
+                 LibSVMIter)

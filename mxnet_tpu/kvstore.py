@@ -1,0 +1,178 @@
+"""KVStore: parameter/gradient synchronization.
+
+Reference parity: src/kvstore/ + python/mxnet/kvstore.py — KVStore.create
+('local', 'device', 'nccl', 'dist_sync', 'dist_device_sync', 'dist_async'),
+init/push/pull/pushpull, set_optimizer (server-side updates), optimizer-state
+save/load, rank/num_workers.
+
+TPU-first redesign (SURVEY.md §2.6): there is no parameter server — push+pull
+is all-reduce.  Within a process, "devices" are a mesh sharding, and reduce
+happens inside the jitted step (mxnet_tpu.parallel); the eager KVStore here
+reduces the per-call value list (the reference's intra-node Comm tree) and,
+for dist_* types on multi-process runs, all-reduces across hosts over
+ICI/DCN using JAX global collectives.  ``dist_async``'s server-side-optimizer
+semantics have no TPU analog and run synchronously (documented drop).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _from_jax
+from . import optimizer as opt
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """In-process KVStore over XLA reductions (reference:
+    include/mxnet/kvstore.h)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._is_dist = kv_type.startswith("dist")
+
+    # -- identity --------------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        if self._is_dist:
+            import jax
+
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._is_dist:
+            import jax
+
+            return jax.process_count()
+        return 1
+
+    # -- data plane ------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            vs = _as_list(v)
+            self._store[k] = vs[0].copy()
+
+    def _normalize(self, key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value)
+        return [key], [value]
+
+    def _reduce(self, values):
+        """Sum a device-value list (reference: Comm tree/NCCL reduce) and,
+        for dist types, all-reduce across processes over ICI/DCN."""
+        vals = _as_list(values)
+        merged = vals[0]
+        for v in vals[1:]:
+            merged = merged + v
+        if self._is_dist and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            raw = merged._data if isinstance(merged, NDArray) else merged
+            gathered = multihost_utils.process_allgather(raw)
+            summed = gathered.sum(axis=0)
+            merged = _from_jax(summed) if isinstance(merged, NDArray) \
+                else summed
+        return merged
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            merged = self._reduce(v)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+            else:
+                stored._set_data(merged._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None, "pull requires out="
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            stored = self._store[k]
+            for dst in _as_list(o):
+                dst._set_data(stored._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull ≡ all-reduce (the TPU-native primitive)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # sparse storage is dense on TPU (see ndarray/sparse.py)
+        self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer plane -------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run parameter updates "in the store" (reference: server-side
+        optimizer execution, src/kvstore/kvstore_dist_server.h)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    # -- misc parity -----------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """Reference: 2-bit gradient compression (gradient_compression.cc).
+        Collectives over ICI are not bandwidth-bound the way PS/TCP was; kept
+        as a no-op knob for API parity."""
+        self._compression_params = compression_params
+
+    def barrier(self):
+        if self._is_dist and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def create(name="local"):
+    """mx.kv.create (reference: KVStore::Create, src/kvstore/kvstore.cc)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "nccl", "local_allreduce_device",
+             "local_allreduce_cpu", "dist_sync", "dist_device_sync",
+             "dist_async", "dist_sync_device", "horovod")
+    if name not in valid:
+        raise MXNetError(f"unknown KVStore type {name}")
+    return KVStore(name)

@@ -17,9 +17,18 @@ from . import random_ops
 from . import linalg
 from . import control_flow
 from . import optimizer_op
+from . import ctc
+from . import rnn as rnn_op
 
 # Re-export every registered pure function at module level so that
-# `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.
+# `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.  A
+# submodule import may have bound a module object under an op name (the
+# import system binds `ops.rnn = <module>` even under `import ... as`);
+# registered op callables win over module objects.
+import types as _types
+
 for _name, _opdef in registry.all_ops().items():
-    globals().setdefault(_name, _opdef.fn)
-del _name, _opdef
+    existing = globals().get(_name)
+    if existing is None or isinstance(existing, _types.ModuleType):
+        globals()[_name] = _opdef.fn
+del _name, _opdef, existing, _types

@@ -1,0 +1,167 @@
+"""Fused multi-layer RNN op.
+
+Reference parity: src/operator/rnn.cc + cudnn_rnn-inl.h — the fused
+LSTM/GRU/vanilla-RNN kernel behind gluon.rnn layers, with cuDNN's packed
+parameter vector layout (all weights layer-major then all biases) and gate
+orders (LSTM: i f g o; GRU: r z n).
+
+TPU-first design: per layer, the input projection for the WHOLE sequence is
+one big MXU matmul (T·B × in) @ (in × G·H); only the recurrent h @ W_hh
+matmul rides inside ``lax.scan``.  Bidirectional runs the reverse direction
+as a flipped scan.  Differentiable by construction (JAX transposes the
+scan), replacing the hand-written cuDNN backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False, projection_size=None):
+    """Total packed parameter count (reference: RNNParam size calc)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            size += gates * state_size * (in_sz + state_size)  # Wx, Wh
+            size += 2 * gates * state_size                     # bx, bh
+    return size
+
+
+def _unpack_params(params, mode, input_size, state_size, num_layers, dirs):
+    """Split the packed vector into per-layer/direction (Wx, Wh, bx, bh)."""
+    gates = _GATES[mode]
+    H = state_size
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * dirs
+        for _ in range(dirs):
+            wx = params[off:off + gates * H * in_sz].reshape(
+                gates * H, in_sz)
+            off += gates * H * in_sz
+            wh = params[off:off + gates * H * H].reshape(gates * H, H)
+            off += gates * H * H
+            weights.append((wx, wh))
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            bx = params[off:off + gates * H]
+            off += gates * H
+            bh = params[off:off + gates * H]
+            off += gates * H
+            biases.append((bx, bh))
+    return weights, biases
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, xproj, wh, bh):
+            h, c = carry
+            gates = xproj + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), \
+                jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        return step
+    if mode == "gru":
+        def step(carry, xproj, wh, bh):
+            (h,) = carry
+            hproj = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xproj, 3, axis=-1)
+            hr, hz, hn = jnp.split(hproj, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h = (1.0 - z) * n + z * h
+            return (h,), h
+        return step
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+    def step(carry, xproj, wh, bh):
+        (h,) = carry
+        h = act(xproj + h @ wh.T + bh)
+        return (h,), h
+    return step
+
+
+def _run_direction(x, h0, c0, wx, wh, bx, bh, mode, reverse):
+    """x: (T,B,in) → outputs (T,B,H), final (h, c?)."""
+    H = wh.shape[1]
+    step = _cell_step(mode, H)
+    xproj = jnp.einsum("tbi,gi->tbg", x, wx,
+                       preferred_element_type=jnp.float32) \
+        .astype(x.dtype) + bx
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def scan_fn(carry, xp):
+        return step(carry, xp, wh, bh)
+
+    final, outs = lax.scan(scan_fn, carry0, xproj)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, final
+
+
+@register("RNN", aliases=("rnn",), mode_dependent=True, random=True)
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, use_sequence_length=False,
+        sequence_length=None, projection_size=None, _is_training=True,
+        _key=None):
+    """Fused RNN forward.  data: (T, B, input) TNC; state: (L*D, B, H);
+    returns output (T, B, H*D) [+ final states when state_outputs]."""
+    T, B, input_size = data.shape
+    H = state_size
+    dirs = 2 if bidirectional else 1
+    weights, biases = _unpack_params(parameters, mode, input_size, H,
+                                     num_layers, dirs)
+    x = data
+    h_finals, c_finals = [], []
+    key = _key
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            wx, wh = weights[idx]
+            bx, bh = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            outs, final = _run_direction(x, h0, c0, wx, wh, bx, bh, mode,
+                                         reverse=(d == 1))
+            outs_dir.append(outs)
+            h_finals.append(final[0])
+            if mode == "lstm":
+                c = final[1]
+                if lstm_state_clip_min is not None and \
+                        lstm_state_clip_max is not None:
+                    c = jnp.clip(c, lstm_state_clip_min,
+                                 lstm_state_clip_max)
+                c_finals.append(c)
+        x = outs_dir[0] if dirs == 1 else jnp.concatenate(outs_dir,
+                                                          axis=-1)
+        if p > 0 and _is_training and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), 0.0).astype(x.dtype)
+    output = x
+    if not state_outputs:
+        return output
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return output, h_out, jnp.stack(c_finals, axis=0)
+    return output, h_out

@@ -1,0 +1,300 @@
+"""RecordIO: the reference's packed-record container format.
+
+Reference parity: 3rdparty/dmlc-core RecordIO codec
+(include/dmlc/recordio.h) + python/mxnet/recordio.py — MXRecordIO,
+MXIndexedRecordIO, IRHeader pack/unpack, pack_img/unpack_img.
+
+Byte-compatible with the reference format: each record is
+``[kMagic:u32][cflag|length:u32][payload][pad to 4]`` with kMagic
+0xced7230a; cflag (upper 3 bits) marks continuation splits when a record
+contains the magic — identical framing, so ``.rec`` files pack with the
+reference's im2rec are readable.
+
+A C++ fast path (src/recordio.cc, loaded via ctypes) handles bulk reads;
+this module is the reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_LENGTH_MASK = (1 << _LFLAG_BITS) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << _LFLAG_BITS) | length
+
+
+def _decode_lrec(data):
+    return data >> _LFLAG_BITS, data & _LENGTH_MASK
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: mx.recordio.MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self, _reopen=False):
+        if self.flag == "w":
+            # a re-open (unpickle / fork reset) must NOT truncate what was
+            # already written — append instead
+            self.handle = open(self.uri, "ab" if _reopen else "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+        self.pid = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (DataLoader worker support)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("handle", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        self.handle = None
+        if is_open:
+            self.open(_reopen=True)
+
+    def _check_pid(self, allow_reset=False):
+        # forked workers must reopen to get their own file offset
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in multiple "
+                                   "processes")
+
+    def write(self, buf):
+        """Write one record with reference framing (continuation-split on
+        embedded magics)."""
+        assert self.writable
+        self._check_pid()
+        magic = buf.find(struct.pack("<I", _MAGIC))
+        if magic == -1:
+            self._write_chunk(0, buf)
+        else:
+            # split into chunks so no payload chunk contains the magic
+            # cflag: 1=start, 2=middle, 3=end of a multi-chunk record
+            chunks = []
+            data = buf
+            while True:
+                idx = data.find(struct.pack("<I", _MAGIC))
+                if idx == -1:
+                    chunks.append(data)
+                    break
+                chunks.append(data[:idx + 2])  # split inside the magic
+                data = data[idx + 2:]
+            for i, c in enumerate(chunks):
+                if i == 0:
+                    cflag = 1
+                elif i == len(chunks) - 1:
+                    cflag = 3
+                else:
+                    cflag = 2
+                self._write_chunk(cflag, c)
+
+    def _write_chunk(self, cflag, data):
+        # each chunk stores its OWN payload length (dmlc framing)
+        self.handle.write(struct.pack("<II", _MAGIC,
+                                      _encode_lrec(cflag, len(data))))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        """Read one record; None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        out = b""
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                if out:
+                    raise MXNetError(f"truncated RecordIO file {self.uri}")
+                return None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise MXNetError(f"Invalid RecordIO magic in {self.uri}")
+            cflag, length = _decode_lrec(lrec)
+            data = self.handle.read(length)
+            self._skip_pad(length)
+            if cflag == 0:
+                return data
+            out += data
+            if cflag == 3:
+                return out
+
+    def _skip_pad(self, length):
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec with .idx sidecar (reference:
+    mx.recordio.MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self, _reopen=False):
+        super().open(_reopen=_reopen)
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            self.fidx = open(self.idx_path, "r")
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "a" if _reopen else "w")
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("fidx", None)
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# -- image record header (reference: python/mxnet/recordio.py IRHeader) --------
+
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class IRHeader:
+    """flag, label, id, id2 (reference: IRHeader namedtuple)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+    def __eq__(self, other):
+        return tuple(self) == tuple(other)
+
+
+def pack(header, s):
+    """Pack a header and byte payload into one record (reference: pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = IRHeader(0, header.label, header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = IRHeader(label.size, 0, header.id, header.id2)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, int(header.flag), float(header.label),
+                    int(header.id), int(header.id2)) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = IRHeader(header.flag, label, header.id, header.id2)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (H,W,C uint8) via PIL encode (reference uses
+    OpenCV)."""
+    from .image import imencode
+
+    return pack(header, imencode(img, quality=quality, img_fmt=img_fmt))
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image ndarray)."""
+    from .image import imdecode_np
+
+    header, s = unpack(s)
+    return header, imdecode_np(s, iscolor)

@@ -11,7 +11,12 @@ import os
 # Must be set before jax is imported anywhere.
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, not setdefault: the container env pins JAX_PLATFORMS=axon (the
+# one-chip TPU tunnel) — tests always run on the virtual CPU mesh.  NOTE:
+# the axon tunnel registers in sitecustomize at interpreter start; run
+# pytest as `env -u PALLAS_AXON_POOL_IPS python -m pytest ...` to skip the
+# tunnel claim entirely (a stale claim otherwise hangs jax init).
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 import pytest

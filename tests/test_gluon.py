@@ -1,0 +1,357 @@
+"""Gluon core tests (reference: tests/python/unittest/test_gluon.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "test.params")
+        params.save(fname)
+        params.load(fname, mx.cpu())
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]], dtype="float32")
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with mx.autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((3, 4, 10))
+    model.initialize()
+    outputs = model(inputs)
+    assert {p.name for p in model.collect_params().values()} == \
+        {"test_weight", "test_bias"}
+    assert outputs.shape == (3, 4, 128)
+
+    model2 = nn.Dense(64, in_units=30, prefix="test2_")
+    inputs2 = mx.nd.zeros((17, 2, 15))
+    model2.initialize()
+    assert model2(inputs2).shape == (17, 64)
+
+
+def test_deferred_init_and_reinit():
+    net = nn.Dense(5)
+    net.initialize()
+    x = mx.nd.ones((3, 7))
+    net(x)
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential_and_getitem():
+    net = nn.Sequential()
+    net.add(nn.Dense(10), nn.Dense(5), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sliced = net[0:2]
+    assert len(sliced) == 2
+
+
+def test_hybrid_matches_eager():
+    np.random.seed(42)
+    mx.random.seed(42)
+
+    def build():
+        net = nn.HybridSequential(prefix="m_")
+        with net.name_scope():
+            net.add(nn.Dense(12, activation="relu"),
+                    nn.LayerNorm(),
+                    nn.Dense(3))
+        return net
+
+    x = mx.nd.random_normal(shape=(4, 6))
+    net = build()
+    net.initialize(init="xavier")
+    eager_out = net(x).asnumpy()
+    net.hybridize()
+    hybrid_out = net(x).asnumpy()
+    np.testing.assert_allclose(eager_out, hybrid_out, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_backward_matches_eager():
+    x = mx.nd.random_normal(shape=(4, 6))
+    label = mx.nd.array([0, 1, 2, 0])
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    grads = []
+    for hybridize in (False, True):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(12, activation="relu"), nn.Dense(3))
+        net.initialize(init="xavier")
+        if hybridize:
+            net.hybridize()
+        with mx.autograd.record():
+            loss = loss_fn(net(x), label)
+        loss.backward()
+        grads.append(net[0].weight.grad().asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-6)
+
+
+def test_batchnorm_moving_stats_update():
+    net = nn.BatchNorm(axis=1, momentum=0.5, in_channels=4)
+    net.initialize()
+    x = mx.nd.random_normal(shape=(8, 4), loc=3.0)
+    with mx.autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    # moving mean pulled toward the batch mean (≈3)
+    assert np.abs(rm).sum() > 0
+    # inference uses moving stats, differs from train-mode output
+    out_inf = net(x).asnumpy()
+    with mx.autograd.record():
+        out_train = net(x).asnumpy()
+    assert not np.allclose(out_inf, out_train)
+
+
+def test_conv_layers_shapes():
+    layers = [
+        (nn.Conv1D(16, 3, in_channels=4), (2, 4, 10), (2, 16, 8)),
+        (nn.Conv2D(16, (3, 4), in_channels=4), (2, 4, 20, 20),
+         (2, 16, 18, 17)),
+        (nn.Conv3D(16, (1, 8, 4), in_channels=4, activation="relu"),
+         (2, 4, 10, 10, 10), (2, 16, 10, 3, 7)),
+        (nn.Conv2DTranspose(16, (3, 4), in_channels=4), (2, 4, 20, 20),
+         (2, 16, 22, 23)),
+        (nn.MaxPool2D((3, 3), 2), (2, 2, 20, 20), (2, 2, 9, 9)),
+        (nn.AvgPool1D(), (2, 2, 10), (2, 2, 5)),
+        (nn.GlobalAvgPool2D(), (2, 2, 8, 8), (2, 2, 1, 1)),
+    ]
+    for layer, in_shape, out_shape in layers:
+        layer.initialize()
+        out = layer(mx.nd.random_normal(shape=in_shape))
+        assert out.shape == out_shape, \
+            f"{layer.__class__.__name__}: {out.shape} != {out_shape}"
+
+
+def test_group_conv():
+    net = nn.Conv2D(8, 3, groups=2, in_channels=4)
+    net.initialize()
+    assert net.weight.shape == (8, 2, 3, 3)
+    out = net(mx.nd.random_normal(shape=(1, 4, 8, 8)))
+    assert out.shape == (1, 8, 6, 6)
+
+
+def test_pool_ceil_mode():
+    # x=6,k=3,s=2: valid → floor(3/2)+1 = 2; full/ceil → ceil(3/2)+1 = 3
+    net = nn.MaxPool2D(3, 2, ceil_mode=True)
+    out = net(mx.nd.random_normal(shape=(1, 1, 6, 6)))
+    assert out.shape == (1, 1, 3, 3)
+    net_v = nn.MaxPool2D(3, 2, ceil_mode=False)
+    assert net_v(mx.nd.random_normal(shape=(1, 1, 6, 6))).shape == \
+        (1, 1, 2, 2)
+
+
+def test_embedding_and_flatten():
+    emb = nn.Embedding(input_dim=20, output_dim=5)
+    emb.initialize()
+    idx = mx.nd.array([[1, 2], [3, 4]])
+    out = emb(idx)
+    assert out.shape == (2, 2, 5)
+    with mx.autograd.record():
+        loss = (emb(idx) * emb(idx)).sum()
+    loss.backward()
+    assert emb.weight.grad().shape == (20, 5)
+
+    f = nn.Flatten()
+    assert f(mx.nd.zeros((2, 3, 4))).shape == (2, 12)
+
+
+def test_lambda_blocks():
+    add = nn.HybridLambda(lambda F, x: x + 2)
+    assert float(add(mx.nd.zeros((1,))).asnumpy()[0]) == 2.0
+    relu_l = nn.Lambda("relu")
+    np.testing.assert_allclose(
+        relu_l(mx.nd.array([-1.0, 1.0])).asnumpy(), [0.0, 1.0])
+
+
+def test_block_attr_registration():
+    class Model(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5)
+                self.dense1 = nn.Dense(5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    model = Model()
+    assert len(model._children) == 2
+    model.initialize()
+    assert model(mx.nd.zeros((2, 4))).shape == (2, 5)
+    assert len(model.collect_params()) == 4
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.BatchNorm())
+    net.initialize()
+    net(mx.nd.zeros((2, 3)))
+    weights = net.collect_params(".*weight")
+    assert all("weight" in k for k in weights.keys())
+    assert len(weights) == 1
+
+
+def test_save_load_parameters_roundtrip():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    x = mx.nd.random_normal(shape=(2, 3))
+    before = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "net.params")
+        net.save_parameters(fname)
+        net2 = nn.HybridSequential()
+        with net2.name_scope():
+            net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+        net2.load_parameters(fname)
+        np.testing.assert_allclose(net2(x).asnumpy(), before, rtol=1e-6)
+
+
+def test_parameter_sharing():
+    shared = nn.Dense(4, in_units=4, prefix="shared_")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(shared)
+        net.add(nn.Dense(4, in_units=4, params=shared.params,
+                         prefix="shared_"))
+    net.initialize()
+    w0 = net[0].weight.data().asnumpy()
+    w1 = net[1].weight.data().asnumpy()
+    np.testing.assert_allclose(w0, w1)
+
+
+def test_losses_basic():
+    pred = mx.nd.array([[1.0, 2.0], [0.5, 0.1]])
+    label2 = mx.nd.array([[1.5, 1.5], [0.0, 0.0]])
+    l2 = gluon.loss.L2Loss()(pred, label2).asnumpy()
+    exp = 0.5 * ((pred.asnumpy() - label2.asnumpy()) ** 2).mean(axis=1)
+    np.testing.assert_allclose(l2, exp, rtol=1e-6)
+
+    l1 = gluon.loss.L1Loss()(pred, label2).asnumpy()
+    np.testing.assert_allclose(
+        l1, np.abs(pred.asnumpy() - label2.asnumpy()).mean(axis=1),
+        rtol=1e-6)
+
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(pred, mx.nd.array([1, 0])).asnumpy()
+    p = pred.asnumpy()
+    logp = p - np.log(np.exp(p).sum(axis=1, keepdims=True))
+    np.testing.assert_allclose(out, [-logp[0, 1], -logp[1, 0]], rtol=1e-5)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = bce(pred, mx.nd.array([[1.0, 0.0], [1.0, 1.0]])).asnumpy()
+    assert np.all(out > 0)
+
+    huber = gluon.loss.HuberLoss()(pred, label2).asnumpy()
+    assert huber.shape == (2,)
+
+    hinge = gluon.loss.HingeLoss()(pred, mx.nd.array([[1.0, -1.0],
+                                                      [1.0, -1.0]]))
+    assert hinge.shape == (2,)
+
+
+def test_ctc_loss():
+    # uniform logits over 3 classes: -log P(label) is analytic
+    T, C = 4, 3
+    pred = mx.nd.zeros((1, T, C))
+    label = mx.nd.array([[1, 2]])
+    loss = gluon.loss.CTCLoss()(pred, label).asnumpy()
+    # all paths equally likely: P = (#valid paths) / C^T
+    # valid CTC alignments of "12" into 4 frames over 3 symbols w/ blank=0
+    assert loss.shape == (1,)
+    assert loss[0] > 0
+
+
+def test_trainer_updates_and_state_roundtrip():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.random_normal(shape=(4, 3))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not np.allclose(w0, net.weight.data().asnumpy())
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "trainer.states")
+        trainer.save_states(fname)
+        trainer.load_states(fname)
+
+
+def test_trainer_lr_control():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((3,)) * 3, mx.nd.ones((4,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert norm == pytest.approx(1.0, rel=1e-4)
+
+
+def test_split_and_load():
+    data = mx.nd.random_normal(shape=(8, 3))
+    splits = gluon.utils.split_data(data, 4)
+    assert len(splits) == 4
+    assert splits[0].shape == (2, 3)
+    loaded = gluon.utils.split_and_load(np.ones((4, 2)), [mx.cpu()])
+    assert loaded[0].shape == (4, 2)

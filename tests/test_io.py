@@ -1,0 +1,232 @@
+"""IO tests (reference: tests/python/unittest/test_io.py,
+test_recordio.py, test_gluon_data.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, recordio
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               labels[:5])
+
+
+def test_ndarray_iter_pad_and_discard():
+    data = np.zeros((7, 2), dtype=np.float32)
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=3,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    assert batches[-1].data[0].shape == (3, 2)
+
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=3,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(12).reshape(12, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(data, np.arange(12), batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_ndarray_iter_dict_input():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                           np.zeros(6), batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert names == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), dtype=np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    resized = mx.io.ResizeIter(base, 5)
+    assert len(list(resized)) == 5
+
+
+def test_prefetching_iter():
+    data = np.random.rand(20, 3).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(20), batch_size=5)
+    pre = mx.io.PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 4
+    pre.reset()
+    assert len(list(pre)) == 4
+
+
+def test_csviter(tmp_path):
+    data = np.random.rand(8, 3).astype(np.float32)
+    labels = np.arange(8).astype(np.float32)
+    data_csv = tmp_path / "data.csv"
+    label_csv = tmp_path / "label.csv"
+    np.savetxt(data_csv, data, delimiter=",")
+    np.savetxt(label_csv, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(data_csv), data_shape=(3,),
+                       label_csv=str(label_csv), batch_size=4)
+    batch = next(iter(it))
+    np.testing.assert_allclose(batch.data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"world" * 3]
+    for p in payloads:
+        writer.write(p)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for expected in payloads:
+        assert reader.read() == expected
+    assert reader.read() is None
+
+
+def test_recordio_magic_embedded(tmp_path):
+    """Payload containing the magic must survive (continuation framing)."""
+    import struct
+
+    path = str(tmp_path / "magic.rec")
+    payload = b"abc" + struct.pack("<I", 0xced7230a) + b"def"
+    w = recordio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        writer.write_idx(i, f"record{i}".encode())
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert reader.read_idx(3) == b"record3"
+    assert reader.read_idx(0) == b"record0"
+    assert reader.keys == list(range(5))
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 42.0, 7, 0)
+    packed = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(packed)
+    assert h2.label == 42.0
+    assert h2.id == 7
+    assert payload == b"payload"
+    # multi-label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 1, 0)
+    h3, payload = recordio.unpack(recordio.pack(header, b"x"))
+    np.testing.assert_allclose(h3.label, [1.0, 2.0, 3.0])
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img((0, 5.0, 1, 0), img, quality=100, img_fmt=".png")
+    header, decoded = recordio.unpack_img(s)
+    assert header.label == 5.0
+    np.testing.assert_array_equal(decoded, img)
+
+
+def test_image_record_iter(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+
+    rec = str(tmp_path / "imgs.rec")
+    writer = recordio.MXRecordIO(rec, "w")
+    for i in range(8):
+        img = (np.random.rand(12, 12, 3) * 255).astype(np.uint8)
+        writer.write(recordio.pack_img((0, float(i % 2), i, 0), img,
+                                       img_fmt=".png"))
+    writer.close()
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 8, 8),
+                         batch_size=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 8, 8)
+    assert batch.label[0].shape == (4,)
+
+
+def test_gluon_dataset_and_dataloader():
+    data = np.random.rand(20, 5).astype(np.float32)
+    labels = np.arange(20).astype(np.float32)
+    ds = gluon.data.ArrayDataset(data, labels)
+    assert len(ds) == 20
+    x, y = ds[3]
+    np.testing.assert_allclose(x, data[3])
+
+    loader = gluon.data.DataLoader(ds, batch_size=6, shuffle=False,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 5)
+    assert batches[-1][0].shape == (2, 5)
+
+
+def test_dataloader_workers():
+    data = np.random.rand(16, 3).astype(np.float32)
+    ds = gluon.data.ArrayDataset(data, np.zeros(16, dtype=np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    total = np.concatenate([b[0].asnumpy() for b in batches])
+    np.testing.assert_allclose(np.sort(total.ravel()),
+                               np.sort(data.ravel()), rtol=1e-6)
+
+
+def test_dataset_transform():
+    ds = gluon.data.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: x * 2)
+    assert doubled[4] == 8
+    ds2 = gluon.data.ArrayDataset(np.ones((4, 2)), np.zeros(4))
+    t = ds2.transform_first(lambda x: x + 1)
+    x, y = t[0]
+    np.testing.assert_allclose(x, 2 * np.ones(2))
+
+
+def test_sampler_batch():
+    s = gluon.data.BatchSampler(gluon.data.SequentialSampler(10), 3,
+                                "keep")
+    assert list(s) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    s = gluon.data.BatchSampler(gluon.data.SequentialSampler(10), 3,
+                                "discard")
+    assert len(list(s)) == 3
+    s = gluon.data.BatchSampler(gluon.data.SequentialSampler(10), 3,
+                                "rollover")
+    assert len(list(s)) == 3
+    assert list(s)[0] == [9, 0, 1]
+
+
+def test_vision_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = mx.nd.array((np.random.rand(10, 12, 3) * 255).astype(np.uint8))
+    t = transforms.ToTensor()
+    out = t(img)
+    assert out.shape == (3, 10, 12)
+    assert out.asnumpy().max() <= 1.0
+
+    norm = transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.1, 0.1, 0.1])
+    out2 = norm(out)
+    assert out2.shape == (3, 10, 12)
+
+    resize = transforms.Resize(6)
+    assert resize(img).shape == (6, 6, 3)
+
+    crop = transforms.CenterCrop(8)
+    assert crop(img).shape == (8, 8, 3)
+
+    comp = transforms.Compose([transforms.Resize(8),
+                               transforms.ToTensor()])
+    assert comp(img).shape == (3, 8, 8)

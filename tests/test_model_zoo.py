@@ -1,0 +1,90 @@
+"""Model zoo tests (reference: tests/python/unittest/test_gluon_model_zoo.py).
+
+Full-resolution ImageNet forwards are exercised on TPU by bench.py; here we
+keep CPU-mesh costs sane: construct every family, forward the cheap ones.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def test_get_model_registry():
+    with pytest.raises(ValueError):
+        vision.get_model("no_such_model")
+    net = vision.get_model("resnet18_v1", classes=10)
+    assert net is not None
+
+
+def test_resnet18_thumbnail_forward():
+    net = vision.get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_v2_thumbnail_forward():
+    net = vision.get_model("resnet18_v2", classes=10, thumbnail=True)
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_structure():
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    # materialize deferred shapes with a tiny spatial input: conv stack
+    # accepts any spatial size >= 32
+    out = net(mx.nd.random_normal(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    # ResNet-50 has ~25.6M parameters
+    assert 24e6 < n_params < 27e6, n_params
+
+
+def test_mobilenet_forward():
+    net = vision.get_model("mobilenet0.25", classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)
+
+
+def test_mobilenet_v2_forward():
+    net = vision.get_model("mobilenetv2_0.25", classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)
+
+
+def test_squeezenet_forward():
+    net = vision.get_model("squeezenet1.1", classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)
+
+
+def test_densenet_constructs():
+    net = vision.densenet121(classes=10)
+    assert net is not None
+
+
+def test_vgg_alexnet_inception_construct():
+    assert vision.vgg11(classes=10) is not None
+    assert vision.alexnet(classes=10) is not None
+    assert vision.inception_v3(classes=10) is not None
+
+
+def test_model_zoo_save_load(tmp_path):
+    net = vision.get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.random_normal(shape=(1, 3, 32, 32))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "r18.params")
+    net.save_parameters(f)
+    net2 = vision.get_model("resnet18_v1", classes=4, thumbnail=True)
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)

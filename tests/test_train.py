@@ -1,0 +1,105 @@
+"""End-to-end convergence tests.
+
+Reference parity: tests/python/train/test_mlp.py / test_conv.py — train a
+tiny model for a few epochs on a small problem and assert an accuracy
+threshold.  This is the go/no-go milestone of SURVEY.md §7.3.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _synthetic_classification(n=512, d=16, classes=4, seed=3):
+    """Linearly separable-ish blobs: learnable to >90% by a small MLP."""
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(-3, 3, size=(classes, d)).astype(np.float32)
+    labels = rng.randint(0, classes, size=n)
+    data = centers[labels] + rng.normal(0, 0.6, size=(n, d)) \
+        .astype(np.float32)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def test_mlp_trains_to_accuracy():
+    data, labels = _synthetic_classification()
+    train_iter = mx.io.NDArrayIter(data, labels, batch_size=64,
+                                   shuffle=True)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(8):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            x = batch.data[0]
+            y = batch.label[0]
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+    name, acc = metric.get()
+    assert acc > 0.9, f"MLP failed to learn: {name}={acc}"
+
+
+def test_cnn_trains_loss_decreases():
+    rng = np.random.RandomState(0)
+    n = 128
+    labels = rng.randint(0, 2, size=n)
+    # class 0: vertical stripe; class 1: horizontal stripe (+noise)
+    data = rng.normal(0, 0.3, size=(n, 1, 8, 8)).astype(np.float32)
+    data[labels == 0, :, :, 3:5] += 1.0
+    data[labels == 1, :, 3:5, :] += 1.0
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(2))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = []
+    it = mx.io.NDArrayIter(data, labels.astype(np.float32), batch_size=32)
+    for epoch in range(8):
+        it.reset()
+        epoch_loss = 0.0
+        nb = 0
+        for batch in it:
+            with mx.autograd.record():
+                loss = loss_fn(net(batch.data[0]), batch.label[0])
+            loss.backward()
+            trainer.step(32)
+            epoch_loss += float(loss.mean().asscalar())
+            nb += 1
+        losses.append(epoch_loss / nb)
+    assert losses[-1] < losses[0] * 0.7, f"loss not decreasing: {losses}"
+
+
+def test_speedometer_reports():
+    import logging
+
+    from mxnet_tpu.callback import BatchEndParam, Speedometer
+
+    speedometer = Speedometer(batch_size=32, frequent=2)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1])], [mx.nd.array([[0.1, 0.9]])])
+    for nbatch in range(1, 5):
+        speedometer(BatchEndParam(epoch=0, nbatch=nbatch,
+                                  eval_metric=metric))
+    assert speedometer.last_speed is not None and \
+        speedometer.last_speed > 0
