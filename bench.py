@@ -1,0 +1,71 @@
+"""Benchmark entry point (driver contract: prints ONE JSON line).
+
+Metric: ResNet-50 training throughput in samples/sec/chip (the BASELINE.md
+headline).  The whole training step — forward, backward, SGD+momentum
+update, BatchNorm stat updates — runs as ONE compiled XLA program
+(parallel.ShardedTrainer) in bfloat16 compute on the MXU.
+
+vs_baseline is null: BASELINE.json.published is {} (reference mount was
+empty — see BASELINE.md provenance note).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    import jax
+
+    n_chips = max(1, len(jax.devices()))
+    batch_size = int(os.environ.get("BENCH_BATCH", 64))
+    image_size = int(os.environ.get("BENCH_IMAGE", 224))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+
+    mesh = parallel.data_parallel_mesh(n_chips)
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((batch_size, 3, image_size, image_size)) \
+        .astype("bfloat16" if hasattr(np, "bfloat16") else "float32")
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, dtype=jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, batch_size).astype("float32"))
+
+    # warmup / compile
+    trainer.step(x, y).wait_to_read()
+    trainer.step(x, y).wait_to_read()
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch_size * steps / dt
+    per_chip = samples_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

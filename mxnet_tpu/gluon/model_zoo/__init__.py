@@ -1,4 +1,8 @@
 """Model zoo (reference: python/mxnet/gluon/model_zoo/)."""
 
 from . import vision
+from . import bert
+from .bert import (BERTModel, BERTPretrainLoss, TransformerEncoder,
+                   TransformerEncoderLayer, bert_base, bert_large,
+                   bert_tiny)
 from .model_store import get_model_file, purge

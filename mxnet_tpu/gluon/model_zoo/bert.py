@@ -1,0 +1,241 @@
+"""Transformer encoder / BERT model family.
+
+Reference parity: the reference repo ships the transformer *kernels*
+(src/operator/contrib/transformer.cu); the BERT model itself lives in
+GluonNLP built on them (SURVEY.md §6 — the BASELINE tokens/sec/chip config).
+This module provides the models natively, TPU-first:
+
+- one packed QKV projection per layer (single MXU matmul);
+- attention impl selectable per model: 'dense' (XLA), 'flash' (Pallas),
+  'ring'/'ulysses' (sequence-parallel over the mesh sp axis);
+- parameter names (qkv_weight, proj_weight, ffn1_weight, ffn2_weight,
+  word_embed_weight) line up with parallel.TRANSFORMER_TP_RULES so the same
+  model shards Megatron-style with zero model changes.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..block import HybridBlock
+from .. import nn
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Pre-LN transformer encoder layer."""
+
+    def __init__(self, units, num_heads, hidden_size=None, dropout=0.1,
+                 attention_impl="dense", activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        hidden_size = hidden_size or 4 * units
+        self._attention_impl = attention_impl
+        self._dropout = dropout
+        self._activation = activation
+        with self.name_scope():
+            self.qkv_weight = self.params.get("qkv_weight",
+                                              shape=(3 * units, units))
+            self.qkv_bias = self.params.get("qkv_bias", shape=(3 * units,),
+                                            init="zeros")
+            self.proj_weight = self.params.get("proj_weight",
+                                               shape=(units, units))
+            self.proj_bias = self.params.get("proj_bias", shape=(units,),
+                                             init="zeros")
+            self.ffn1_weight = self.params.get("ffn1_weight",
+                                               shape=(hidden_size, units))
+            self.ffn1_bias = self.params.get("ffn1_bias",
+                                             shape=(hidden_size,),
+                                             init="zeros")
+            self.ffn2_weight = self.params.get("ffn2_weight",
+                                               shape=(units, hidden_size))
+            self.ffn2_bias = self.params.get("ffn2_bias", shape=(units,),
+                                             init="zeros")
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            if dropout:
+                self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, qkv_weight, qkv_bias, proj_weight,
+                       proj_bias, ffn1_weight, ffn1_bias, ffn2_weight,
+                       ffn2_bias, mask=None):
+        h = self.ln1(x)
+        attn = F.multi_head_attention(
+            h, h, h, qkv_weight=qkv_weight, qkv_bias=qkv_bias,
+            proj_weight=proj_weight, proj_bias=proj_bias,
+            num_heads=self._num_heads, mask=mask,
+            impl=self._attention_impl)
+        if self._dropout:
+            attn = self.drop(attn)
+        x = x + attn
+        h = self.ln2(x)
+        h = F.FullyConnected(h, ffn1_weight, ffn1_bias,
+                             num_hidden=ffn1_weight.shape[0],
+                             flatten=False)
+        h = F.Activation(h, act_type=self._activation)
+        h = F.FullyConnected(h, ffn2_weight, ffn2_bias,
+                             num_hidden=ffn2_weight.shape[0],
+                             flatten=False)
+        if self._dropout:
+            h = self.drop(h)
+        return x + h
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, num_heads, hidden_size=None,
+                 dropout=0.1, attention_impl="dense", **kwargs):
+        super().__init__(**kwargs)
+        self._num_layers = num_layers
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.layers.add(TransformerEncoderLayer(
+                    units, num_heads, hidden_size, dropout,
+                    attention_impl, prefix=f"layer{i}_"))
+            self.ln_f = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x):
+        x = self.layers(x)
+        return self.ln_f(x)
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder with MLM + NSP heads (BASELINE: tokens/sec/chip
+    pretrain config)."""
+
+    def __init__(self, vocab_size=30522, units=768, num_layers=12,
+                 num_heads=12, hidden_size=3072, max_length=512,
+                 token_types=2, dropout=0.1, attention_impl="dense",
+                 use_pooler=True, use_decoder=True, use_classifier=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        with self.name_scope():
+            self.word_embed_weight = self.params.get(
+                "word_embed_weight", shape=(vocab_size, units),
+                init="normal")
+            self.token_type_embed_weight = self.params.get(
+                "token_type_embed_weight", shape=(token_types, units),
+                init="normal")
+            self.position_embed_weight = self.params.get(
+                "position_embed_weight", shape=(max_length, units),
+                init="normal")
+            self.embed_ln = nn.LayerNorm(in_channels=units)
+            if dropout:
+                self.embed_drop = nn.Dropout(dropout)
+            self._dropout = dropout
+            self.encoder = TransformerEncoder(
+                num_layers, units, num_heads, hidden_size, dropout,
+                attention_impl, prefix="enc_")
+            if use_pooler:
+                self.pooler = nn.Dense(units, activation="tanh",
+                                       in_units=units, prefix="pooler_")
+            if use_decoder:
+                # MLM head: transform + tied-embedding decode
+                self.decoder_transform = nn.Dense(
+                    units, activation="gelu", in_units=units,
+                    flatten=False, prefix="dec_transform_")
+                self.decoder_ln = nn.LayerNorm(in_channels=units)
+                self.decoder_bias = self.params.get(
+                    "decoder_bias", shape=(vocab_size,), init="zeros")
+            if use_classifier:
+                self.nsp_classifier = nn.Dense(2, in_units=units,
+                                               prefix="nsp_")
+
+    def hybrid_forward(self, F, inputs, token_types=None,
+                       word_embed_weight=None, token_type_embed_weight=None,
+                       position_embed_weight=None, decoder_bias=None):
+        T = inputs.shape[1]
+        x = F.Embedding(inputs, word_embed_weight)
+        if token_types is not None:
+            x = x + F.Embedding(token_types, token_type_embed_weight)
+        else:
+            x = x + token_type_embed_weight[0]
+        x = x + position_embed_weight[:T]
+        x = self.embed_ln(x)
+        if self._dropout:
+            x = self.embed_drop(x)
+        seq = self.encoder(x)  # (B, T, C)
+        outputs = [seq]
+        if self._use_pooler:
+            pooled = self.pooler(seq[:, 0, :])
+            outputs.append(pooled)
+            if self._use_classifier:
+                outputs.append(self.nsp_classifier(pooled))
+        if self._use_decoder:
+            h = self.decoder_transform(seq)
+            h = self.decoder_ln(h)
+            logits = F.FullyConnected(
+                h, word_embed_weight, decoder_bias,
+                num_hidden=word_embed_weight.shape[0], flatten=False)
+            outputs.append(logits)
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+
+class BERTPretrainLoss(HybridBlock):
+    """MLM + NSP loss over BERTModel outputs (masked-position MLM)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        from .. import loss as loss_mod
+
+        self._ce = loss_mod.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, outputs, labels):
+        # outputs: (seq, pooled, nsp_logits, mlm_logits)
+        # labels: dict-free tuple (mlm_labels (B,T) with -1 for unmasked,
+        #         nsp_labels (B,))
+        import jax.numpy as jnp
+
+        seq, pooled, nsp_logits, mlm_logits = outputs
+        mlm_labels, nsp_labels = labels
+        raw = mlm_labels._data if hasattr(mlm_labels, "_data") \
+            else mlm_labels
+        mlm_raw = mlm_logits._data if hasattr(mlm_logits, "_data") \
+            else mlm_logits
+        valid = (raw >= 0)
+        safe_labels = jnp.maximum(raw, 0).astype(jnp.int32)
+        logp = _log_softmax(mlm_raw)
+        nll = -jnp.take_along_axis(
+            logp, safe_labels[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        mlm_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+        nsp_loss = self._ce(nsp_logits, nsp_labels)
+        nsp_raw = nsp_loss._data if hasattr(nsp_loss, "_data") else nsp_loss
+        total = mlm_loss + jnp.mean(nsp_raw)
+        from ...ndarray.ndarray import NDArray, _from_jax
+
+        if isinstance(seq, NDArray):
+            return _from_jax(total)
+        return total
+
+
+def _log_softmax(x):
+    import jax
+
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+import jax  # noqa: E402  (used inside hybrid paths)
+
+
+def bert_base(**kwargs):
+    return BERTModel(units=768, num_layers=12, num_heads=12,
+                     hidden_size=3072, **kwargs)
+
+
+def bert_large(**kwargs):
+    return BERTModel(units=1024, num_layers=24, num_heads=16,
+                     hidden_size=4096, **kwargs)
+
+
+def bert_tiny(**kwargs):
+    """Testing-scale config."""
+    kwargs.setdefault("vocab_size", 1024)
+    kwargs.setdefault("max_length", 128)
+    return BERTModel(units=64, num_layers=2, num_heads=4,
+                     hidden_size=128, **kwargs)

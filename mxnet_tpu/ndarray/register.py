@@ -17,6 +17,7 @@ from __future__ import annotations
 import sys
 
 from .. import autograd as _ag
+from .. import profiler as _prof
 from ..ops import registry as _registry
 from ..ops.registry import OpDef
 from .ndarray import NDArray, _from_jax
@@ -41,10 +42,24 @@ def invoke(opdef: OpDef, args: tuple, kwargs: dict):
     name = kwargs.pop("name", None)  # symbol-compat: ignored eagerly
     kwargs = _inject(opdef, kwargs)
     fn = opdef.fn
-    if out_arr is not None or req_ctx is not None:
+    if _prof._S.running:  # cheap flag read on the hot path
+        with _prof.op_span(opdef.name):
+            result = _invoke_inner(opdef, fn, args, kwargs)
+            if _prof.want_sync():
+                _block_result(result)
+    else:
         result = _invoke_inner(opdef, fn, args, kwargs)
+    if out_arr is not None or req_ctx is not None:
         return _finalize(result, out_arr, req_ctx)
-    return _invoke_inner(opdef, fn, args, kwargs)
+    return result
+
+
+def _block_result(result):
+    items = result if isinstance(result, (tuple, list)) else (result,)
+    for r in items:
+        data = getattr(r, "_data", r)
+        if hasattr(data, "block_until_ready"):
+            data.block_until_ready()
 
 
 def _finalize(result, out_arr, req_ctx):

@@ -19,6 +19,8 @@ from . import control_flow
 from . import optimizer_op
 from . import ctc
 from . import rnn as rnn_op
+from . import attention
+from . import contrib_det
 
 # Re-export every registered pure function at module level so that
 # `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.  A

@@ -1,0 +1,147 @@
+"""Attention ops.
+
+Reference parity: src/operator/contrib/transformer.cu (≥1.5 interleaved
+self-attention GEMM ops: interleaved_matmul_selfatt_qk / valatt, plus
+multi-head attention support ops).  TPU-first: attention is expressed as
+einsums XLA maps straight onto the MXU; the sequence-parallel variants
+(ring / ulysses, parallel/ring.py) plug in via ``impl=``; the Pallas
+flash-attention kernel (ops/pallas_attention.py) takes over for long
+sequences on real TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_NEG = -1e30
+
+
+@register("scaled_dot_product_attention", random=True,
+          mode_dependent=True)
+def scaled_dot_product_attention(query, key, value, mask=None,
+                                 causal=False, scale=None, impl="dense",
+                                 dropout_p=0.0, _key=None,
+                                 _is_training=True):
+    """q,k,v: (B, H, T, D).  mask: broadcastable to (B, H, Tq, Tk), 1=keep.
+
+    impl: 'dense' | 'ring' | 'ulysses' | 'flash' (flash falls back to dense
+    off-TPU).  mask/dropout are dense-path features; the sharded/fused
+    impls reject them loudly instead of silently ignoring them.
+    """
+    if scale is None:
+        scale = query.shape[-1] ** -0.5
+    if impl != "dense" and (mask is not None or dropout_p > 0.0):
+        raise NotImplementedError(
+            f"attention impl={impl!r} supports only causal masking; "
+            "explicit masks / attention dropout require impl='dense'")
+    if impl == "ring":
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(query, key, value, causal=causal,
+                              scale=scale)
+    if impl == "ulysses":
+        from ..parallel.ring import ulysses_attention
+
+        return ulysses_attention(query, key, value, causal=causal,
+                                 scale=scale)
+    if impl == "flash":
+        from .pallas_attention import flash_attention
+
+        return flash_attention(query, key, value, causal=causal,
+                               scale=scale)
+    s = jnp.einsum("bhqd,bhkd->bhqk", query.astype(jnp.float32),
+                   key.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        cmask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(cmask[None, None], s, _NEG)
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and _is_training:
+        keep = jax.random.bernoulli(_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      value.astype(jnp.float32)).astype(query.dtype)
+
+
+def _split_heads(x, num_heads):
+    B, T, C = x.shape
+    return x.reshape(B, T, num_heads, C // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    B, H, T, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+
+
+@register("multi_head_attention")
+def multi_head_attention(query, key, value, qkv_weight=None, qkv_bias=None,
+                         proj_weight=None, proj_bias=None, num_heads=1,
+                         mask=None, causal=False, impl="dense"):
+    """Full fused MHA on (B, T, C) inputs with packed qkv projection
+    (reference: the contrib/transformer interleaved kernels fused exactly
+    this to avoid three GEMMs — one packed MXU matmul here)."""
+    if qkv_weight is not None:
+        if query is key and key is value:
+            qkv = jnp.einsum("btc,gc->btg", query, qkv_weight)
+            if qkv_bias is not None:
+                qkv = qkv + qkv_bias
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            wq, wk, wv = jnp.split(qkv_weight, 3, axis=0)
+            bq = bk = bv = None
+            if qkv_bias is not None:
+                bq, bk, bv = jnp.split(qkv_bias, 3, axis=0)
+            q = jnp.einsum("btc,gc->btg", query, wq)
+            k = jnp.einsum("btc,gc->btg", key, wk)
+            v = jnp.einsum("btc,gc->btg", value, wv)
+            if bq is not None:
+                q, k, v = q + bq, k + bk, v + bv
+    else:
+        q, k, v = query, key, value
+    qh = _split_heads(q, num_heads)
+    kh = _split_heads(k, num_heads)
+    vh = _split_heads(v, num_heads)
+    out = scaled_dot_product_attention(qh, kh, vh, mask=mask,
+                                       causal=causal, impl=impl)
+    out = _merge_heads(out)
+    if proj_weight is not None:
+        out = jnp.einsum("btg,cg->btc", out, proj_weight)
+        if proj_bias is not None:
+            out = out + proj_bias
+    return out
+
+
+# reference contrib op names (src/operator/contrib/transformer.cu): the
+# interleaved projections as explicit ops for API parity
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """Input (T, B, 3C) interleaved qkv → scores (B*heads, T, T)."""
+    T, B, C3 = queries_keys_values.shape
+    C = C3 // 3
+    x = queries_keys_values.reshape(T, B, heads, 3 * (C // heads))
+    q, k, _ = jnp.split(x, 3, axis=-1)
+    q = q.transpose(1, 2, 0, 3).reshape(B * heads, T, C // heads)
+    k = k.transpose(1, 2, 0, 3).reshape(B * heads, T, C // heads)
+    scale = (C // heads) ** -0.5
+    return jnp.einsum("nqd,nkd->nqk", q, k) * scale
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    """attention (B*heads, T, T) × interleaved values → (T, B, C)."""
+    T, B, C3 = queries_keys_values.shape
+    C = C3 // 3
+    x = queries_keys_values.reshape(T, B, heads, 3 * (C // heads))
+    _, _, v = jnp.split(x, 3, axis=-1)
+    v = v.transpose(1, 2, 0, 3).reshape(B * heads, T, C // heads)
+    out = jnp.einsum("nqk,nkd->nqd", attention, v)
+    out = out.reshape(B, heads, T, C // heads).transpose(2, 0, 1, 3)
+    return out.reshape(T, B, C)

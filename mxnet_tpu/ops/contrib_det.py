@@ -1,0 +1,392 @@
+"""Detection ops (SSD / Faster-RCNN family).
+
+Reference parity: src/operator/contrib/ — MultiBoxPrior, MultiBoxTarget,
+MultiBoxDetection (multibox_*.cc), box_nms/box_iou/bipartite_matching
+(bounding_box.cc), ROIPooling (../roi_pooling.cc), ROIAlign
+(roi_align.cc).
+
+TPU-first: these were the reference's dynamic-shape CUDA kernels; here they
+are STATIC-shape jax programs (SURVEY.md §7 hard-parts item): NMS keeps the
+fixed-length score-sorted list and marks suppressed entries invalid (-1)
+instead of shrinking, exactly the padded contract the reference's
+``box_nms`` already exposes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _corner_iou(a, b):
+    """IoU of (..., 4) corner boxes against (..., 4)."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: bounding_box.cc box_iou)."""
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _corner_iou(lhs[..., :, None, :], rhs[..., None, :, :])
+
+
+def _center_to_corner(b):
+    x, y, w, h = (b[..., 0], b[..., 1], b[..., 2], b[..., 3])
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Greedy NMS with the reference's padded semantics: output has the
+    SAME shape, suppressed/invalid entries have score (and id) set to -1.
+
+    data: (..., N, K) with scores at score_index and box corners at
+    coord_start..coord_start+4.
+    """
+    batched = data.ndim == 3
+    if not batched:
+        data = data[None]
+
+    def one(sample):
+        N = sample.shape[0]
+        scores = sample[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(sample, coord_start, 4, axis=1)
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        ids = sample[:, id_index] if id_index >= 0 else \
+            jnp.zeros((N,))
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= ids != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        k = N if topk <= 0 else min(topk, N)
+        sboxes = boxes[order]
+        svalid = valid[order]
+        sids = ids[order]
+        # boxes ranked past topk are dropped outright
+        rank = jnp.arange(N)
+        svalid &= rank < k
+        iou = _corner_iou(sboxes[:, None, :], sboxes[None, :, :])
+        same_class = jnp.ones((N, N), bool) if force_suppress or \
+            id_index < 0 else (sids[:, None] == sids[None, :])
+        suppress_pair = (iou > overlap_thresh) & same_class
+
+        def body(i, keep):
+            # i suppresses later j when i itself is kept
+            cur = keep[i] & svalid[i]
+            mask = suppress_pair[i] & (jnp.arange(N) > i) & cur
+            return keep & ~mask
+
+        keep = lax.fori_loop(0, N, body, jnp.ones((N,), bool))
+        keep &= svalid
+        out = sample[order]
+        out = out.at[:, score_index].set(
+            jnp.where(keep, out[:, score_index], -1.0))
+        if id_index >= 0:
+            out = out.at[:, id_index].set(
+                jnp.where(keep, out[:, id_index], -1.0))
+        return out
+
+    out = jax.vmap(one)(data)
+    return out if batched else out[0]
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",))
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (reference: bounding_box.cc).
+
+    data: (B, N, M) pairwise scores → (row_match (B,N), col_match (B,M)).
+    """
+    def one(scores):
+        N, M = scores.shape
+        order = -scores if not is_ascend else scores
+        row = jnp.full((N,), -1.0)
+        col = jnp.full((M,), -1.0)
+        k = min(N, M) if topk <= 0 else min(topk, min(N, M))
+
+        def body(_, state):
+            row, col, s = state
+            idx = jnp.argmin(s) if is_ascend else jnp.argmax(s)
+            i, j = idx // M, idx % M
+            val = s[i, j]
+            ok = (val >= threshold) if not is_ascend else \
+                (val <= threshold)
+            ok &= (row[i] < 0) & (col[j] < 0)
+            row = jnp.where(ok, row.at[i].set(j.astype(row.dtype)), row)
+            col = jnp.where(ok, col.at[j].set(i.astype(col.dtype)), col)
+            blocked = s.at[i, :].set(-jnp.inf if not is_ascend
+                                     else jnp.inf)
+            blocked = blocked.at[:, j].set(-jnp.inf if not is_ascend
+                                           else jnp.inf)
+            s = jnp.where(ok, blocked, blocked)  # always block the pair
+            return row, col, s
+
+        row, col, _ = lax.fori_loop(0, k, body,
+                                    (row, col, scores.astype(jnp.float32)))
+        return row, col
+
+    return jax.vmap(one)(data)
+
+
+@register("MultiBoxPrior", aliases=("multibox_prior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (reference: multibox_prior.cc).  data gives the
+    feature map (B, C, H, W); output (1, H*W*(S+R-1), 4) corner anchors."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                    axis=-1).reshape(H * W, 2)
+    wh = []
+    for i, s in enumerate(sizes):
+        wh.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        wh.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    wh = jnp.asarray(wh)  # (A, 2) as (w, h)
+    A = wh.shape[0]
+    centers = jnp.repeat(cyx, A, axis=0)          # (HW*A, 2) (cy, cx)
+    whs = jnp.tile(wh, (H * W, 1))                # (HW*A, 2)
+    anchors = jnp.stack([
+        centers[:, 1] - whs[:, 0] / 2,   # xmin
+        centers[:, 0] - whs[:, 1] / 2,   # ymin
+        centers[:, 1] + whs[:, 0] / 2,   # xmax
+        centers[:, 0] + whs[:, 1] / 2,   # ymax
+    ], axis=-1)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors[None]
+
+
+@register("MultiBoxTarget", aliases=("multibox_target",))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1, negative_mining_ratio=-1,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth and encode regression targets
+    (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corners; label: (B, M, 5) [cls, xmin, ymin, xmax,
+    ymax] padded with cls=-1; returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N))."""
+    anchors = anchor[0]  # (N, 4)
+    N = anchors.shape[0]
+    var = jnp.asarray(variances)
+
+    def one(lab, pred):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _corner_iou(anchors[:, None, :], gt_boxes[None, :, :])
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)       # per gt
+        # .max, not .set: padded gts share argmax index 0 with real gts
+        # and a duplicate-index .set would let their False win
+        force = jnp.zeros((N,), bool)
+        force = force.at[best_anchor].max(gt_valid)
+        gt_of_anchor = jnp.where(
+            force, jnp.argmax(
+                jnp.where(force[:, None],
+                          (best_anchor[None, :] ==
+                           jnp.arange(N)[:, None]) * 1.0, 0.0), axis=1),
+            best_gt)
+        matched = matched | force
+        g = gt_boxes[gt_of_anchor]
+        # encode center offsets normalized by variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        loc = jnp.stack([
+            (gcx - acx) / jnp.maximum(aw, 1e-8) / var[0],
+            (gcy - acy) / jnp.maximum(ah, 1e-8) / var[1],
+            jnp.log(gw / jnp.maximum(aw, 1e-8)) / var[2],
+            jnp.log(gh / jnp.maximum(ah, 1e-8)) / var[3]], axis=-1)
+        loc_mask = jnp.repeat(matched.astype(jnp.float32), 4)
+        loc_target = (loc * matched[:, None]).reshape(-1)
+        cls_target = jnp.where(matched,
+                               lab[gt_of_anchor, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (reference: multibox_target.cc): keep
+            # only the most-confidently-wrong negatives; the rest get
+            # ignore_label and drop out of the classification loss
+            max_fg = jnp.max(pred[1:], axis=0) if pred.shape[0] > 1 \
+                else pred[0]
+            neg_cand = (~matched) & (best_iou < negative_mining_thresh)
+            num_neg = jnp.maximum(
+                jnp.sum(matched) * negative_mining_ratio,
+                minimum_negative_samples)
+            negness = jnp.where(neg_cand, max_fg, -jnp.inf)
+            rank = jnp.argsort(jnp.argsort(-negness))
+            selected_neg = neg_cand & (rank < num_neg)
+            cls_target = jnp.where(
+                matched, cls_target,
+                jnp.where(selected_neg, 0.0, float(ignore_label)))
+        return loc_target, loc_mask, cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+@register("MultiBoxDetection", aliases=("multibox_detection",))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions into detections + NMS (reference:
+    multibox_detection.cc).  Output (B, N, 6): [id, score, xmin, ymin,
+    xmax, ymax], invalid rows id=-1."""
+    anchors = anchor[0]
+    var = jnp.asarray(variances)
+    B, C, N = cls_prob.shape
+
+    def one(prob, loc):
+        loc = loc.reshape(N, 4)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                           cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate(
+            [prob[:background_id], prob[background_id + 1:]], axis=0) \
+            if C > 1 else prob
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        det = jnp.concatenate([
+            jnp.where(keep, cls_id, -1.0)[:, None],
+            jnp.where(keep, score, -1.0)[:, None], boxes], axis=-1)
+        det = box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                      topk=nms_topk, coord_start=2, score_index=1,
+                      id_index=0, force_suppress=force_suppress)
+        return det
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max ROI pooling (reference: src/operator/roi_pooling.cc).
+    data (B,C,H,W); rois (R,5) [batch_idx, x1, y1, x2, y2]."""
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = data[b]  # (C,H,W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(py, px):
+            hs = y1 + (py * rh) // PH
+            he = y1 + -(-((py + 1) * rh) // PH)
+            ws = x1 + (px * rw) // PW
+            we = x1 + -(-((px + 1) * rw) // PW)
+            mask = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                    & (xs[None, :] >= ws) & (xs[None, :] < we))
+            vals = jnp.where(mask[None], img, -jnp.inf)
+            out = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        py, px = jnp.meshgrid(jnp.arange(PH), jnp.arange(PW),
+                              indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(py, px)  # (PH, PW, C)
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("roi_align", "ROIAlign"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """Bilinear ROI align (reference: roi_align.cc, Mask-RCNN)."""
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+    offset = 0.5 if aligned else 0.0
+    sr = max(int(sample_ratio), 1)
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy1 = y - y0
+        wx1 = x - x0
+        v = (img[:, y0, x0] * (1 - wy1) * (1 - wx1)
+             + img[:, y1, x0] * wy1 * (1 - wx1)
+             + img[:, y0, x1] * (1 - wy1) * wx1
+             + img[:, y1, x1] * wy1 * wx1)
+        return v
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-8)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-8)
+        bin_w = rw / PW
+        bin_h = rh / PH
+        img = data[b]
+
+        def cell(py, px):
+            acc = jnp.zeros((C,))
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + (py + (iy + 0.5) / sr) * bin_h
+                    x = x1 + (px + (ix + 0.5) / sr) * bin_w
+                    acc = acc + bilinear(img, y, x)
+            return acc / (sr * sr)
+
+        py, px = jnp.meshgrid(jnp.arange(PH, dtype=jnp.float32),
+                              jnp.arange(PW, dtype=jnp.float32),
+                              indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(py, px)
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one)(rois)

@@ -1,0 +1,26 @@
+"""Parallelism over TPU device meshes.
+
+NEW, TPU-first (SURVEY.md §2.5/§2.6): replaces the reference's
+KVStore/NCCL/parameter-server scaling with mesh shardings + XLA collectives:
+
+- mesh: named-axis device meshes (dp/tp/pp/sp/ep)
+- ShardedTrainer: the whole training step as one compiled XLA program
+- sharding: Megatron-style tensor-parallel parameter rules
+- ring: ring attention + Ulysses sequence parallelism
+- pipeline: GPipe-style microbatch pipelining via ppermute
+- collectives: eager collective helpers + the bandwidth measurement tool
+  (reference twin: tools/bandwidth)
+"""
+
+from . import collectives
+from . import mesh
+from .mesh import (DP, EP, PP, SP, TP, data_parallel_mesh, default_mesh,
+                   make_mesh, set_default_mesh)
+from . import sharding
+from .sharding import ShardingRules, TRANSFORMER_TP_RULES, annotate_block
+from . import ring
+from .ring import ring_attention, ulysses_attention
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
+from . import trainer
+from .trainer import DataParallelTrainer, ShardedTrainer

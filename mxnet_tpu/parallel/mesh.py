@@ -1,0 +1,89 @@
+"""Device meshes.
+
+NEW, TPU-first (SURVEY.md §2.5/§2.6): the reference scales by replicating
+per-GPU handles + NCCL/PS reduction; here multi-chip scale is a
+``jax.sharding.Mesh`` with named axes and everything else is a sharding
+annotation.  Axis-name conventions used across the framework:
+
+- ``dp``: data parallel (batch dim)
+- ``tp``: tensor parallel (Megatron-style weight sharding)
+- ``pp``: pipeline stages
+- ``sp``: sequence/context parallel (ring attention)
+- ``ep``: expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
+
+
+def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
+    """Build a Mesh with the canonical axis order (pp, dp, sp, ep, tp).
+
+    tp innermost: it carries the most latency-sensitive collectives, and the
+    innermost mesh dim maps to physically-adjacent chips on the ICI torus
+    (the scaling-book layout recipe).  pp outermost: stage transfers are
+    point-to-point and tolerate DCN.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    sizes = {"pp": pp, "dp": dp, "sp": sp, "ep": ep, "tp": tp}
+    axes = [(name, size) for name, size in sizes.items() if size > 1]
+    if not axes:
+        axes = [("dp", 1)]
+    total = 1
+    for _, s in sizes.items():
+        total *= s
+    if total > len(devices):
+        raise MXNetError(
+            f"mesh {sizes} needs {total} devices but only "
+            f"{len(devices)} available")
+    names = [n for n, _ in axes]
+    shape = [s for _, s in axes]
+    arr = _np.asarray(devices[:total]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(n=None):
+    import jax
+
+    n = n or len(jax.devices())
+    return make_mesh(dp=n)
+
+
+def mesh_axis_size(mesh, name):
+    return mesh.shape.get(name, 1)
+
+
+_DEFAULT_MESH = None
+
+
+def set_default_mesh(mesh):
+    """Set the process-wide default mesh (consumed by ring attention and
+    other mesh-aware ops when no mesh is passed explicitly)."""
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+    return mesh
+
+
+def default_mesh():
+    return _DEFAULT_MESH
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))

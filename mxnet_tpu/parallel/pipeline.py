@@ -1,0 +1,104 @@
+"""Pipeline parallelism.
+
+Reference parity: the reference's only model-parallel mechanism is
+``group2ctx`` device placement (SURVEY.md §2.5 — nnvm PlaceDevice pass +
+example/model-parallel-lstm).  This module is the real thing, TPU-first:
+GPipe-style microbatch pipelining as ONE jitted program over the mesh
+``pp`` axis using shard_map + ppermute — stage transfers are point-to-point
+neighbor pushes on the ICI/DCN torus.
+
+Design: every device holds ITS stage's parameters (stacked stage-major
+arrays sharded on pp); the schedule runs num_micro + num_stages - 1 ticks;
+at each tick every device runs its stage on the activation it holds, then
+ppermutes activations forward one stage.  This is the standard SPMD
+"collective pipeline" formulation — no per-stage programs, one XLA module.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .mesh import PP, default_mesh
+
+
+def pipeline_apply(stage_fn, params_stacked, x_micro, mesh=None, axis=PP):
+    """Run a pipelined forward.
+
+    stage_fn(stage_params, x) -> y : the per-stage computation (all stages
+    must share one signature/shape — the usual homogeneous-transformer
+    assumption).
+    params_stacked: pytree whose leaves have leading dim = n_stages,
+    sharded on `axis`.
+    x_micro: (n_micro, mb, ...) microbatched input, replicated.
+    Returns (n_micro, mb, ...) outputs from the LAST stage (replicated).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    mesh = mesh or default_mesh()
+    if mesh is None:
+        raise MXNetError("pipeline_apply needs a mesh")
+    n_stages = mesh.shape.get(axis, 1)
+    n_micro = x_micro.shape[0]
+    if n_micro < n_stages:
+        raise MXNetError(
+            f"pipeline needs n_micro ({n_micro}) >= n_stages "
+            f"({n_stages}) to fill the pipe")
+
+    pspec = jax.tree_util.tree_map(
+        lambda _: PartitionSpec(axis), params_stacked)
+    xspec = PartitionSpec()
+
+    def local(params, xs):
+        # params leaves: (1, ...) — this device's stage slice
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        n_ticks = n_micro + n_stages - 1
+        mb_shape = xs.shape[1:]
+        out_shape = jax.eval_shape(
+            lambda p, x: stage_fn(p, x), my_params,
+            jax.ShapeDtypeStruct(mb_shape, xs.dtype))
+        carry_in = jnp.zeros(mb_shape, xs.dtype)
+        outs = jnp.zeros((n_micro,) + tuple(out_shape.shape),
+                         out_shape.dtype)
+        fwd_perm = [(r, (r + 1) % n_stages) for r in range(n_stages)]
+
+        def tick(t, state):
+            carry, outs = state
+            # stage 0 ingests microbatch t (when in range)
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            my_in = jnp.where(stage == 0, xs[feed_idx], carry)
+            y = stage_fn(my_params, my_in)
+            # last stage emits microbatch (t - n_stages + 1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            emit = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            outs = lax.cond(
+                emit,
+                lambda o: o.at[out_idx].set(y.astype(outs.dtype)),
+                lambda o: o, outs)
+            carry = lax.ppermute(y, axis, fwd_perm)
+            return carry, outs
+
+        _, outs = lax.fori_loop(0, n_ticks, tick, (carry_in, outs))
+        # the last stage holds the real outputs; broadcast to all
+        outs = lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis)
+        return outs
+
+    fn = shard_map(local, mesh=mesh, in_specs=(pspec, xspec),
+                   out_specs=xspec, check_rep=False)
+    return fn(params_stacked, x_micro)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] → one tree with stacked leaves
+    (leading dim = n_stages) ready to shard on pp."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)

@@ -1,0 +1,220 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+NEW, TPU-first (SURVEY.md §5.7: absent in the 2018-era reference, required
+by the long-context BERT/NMT configs).  Two strategies over the mesh ``sp``
+axis:
+
+- **Ring attention** (Liu et al. 2023): Q stays local; K/V blocks rotate
+  around the ring via ``ppermute`` while a flash-style online-softmax
+  accumulator folds each block in.  Peak memory is O(T/p) per chip and the
+  KV transfer overlaps the local block matmul on ICI.
+- **Ulysses** (DeepSpeed-Ulysses): ``all_to_all`` reshards sequence ↔ heads
+  so each chip runs FULL-sequence attention for T/p of the heads — cheaper
+  collectives when head count ≥ ring size.
+
+Both are differentiable by construction (shard_map transposes) and run on
+the virtual CPU mesh for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from .mesh import SP, default_mesh
+
+_NEG_INF = -1e30
+
+
+def _pvary(x, axis):
+    """Mark an array as varying over `axis` inside shard_map (needed for
+    scan/fori carries whose body mixes in device-dependent values)."""
+    import jax
+    from jax import lax
+
+    try:
+        if axis in jax.typeof(x).vma:
+            return x  # already varying
+    except Exception:
+        pass
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis,))
+    return lax.pcast(x, (axis,), to="varying")
+
+
+def _place(mesh, spec, *arrays):
+    """Eagerly-called shard_map needs concrete inputs laid on the mesh;
+    tracers (inside an enclosing jit) pass through untouched.  Returns the
+    placed arrays plus an `eager` flag so the caller can un-commit its
+    output (eager callers mix results with single-device arrays)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    out = []
+    eager = False
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            out.append(a)
+        else:
+            eager = True
+            out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out), eager
+
+
+def _uncommit(x, eager):
+    """Bring an eager result back to the default device so it composes
+    with ordinary single-device arrays (debug/eager path only — under jit
+    the sharding stays)."""
+    import jax
+
+    if not eager or isinstance(x, jax.core.Tracer):
+        return x
+    import numpy as _host_np
+
+    return jax.device_put(_host_np.asarray(x), jax.devices()[0])
+
+
+def _online_block(o, l, m, s, v):
+    """Fold one score block into the flash accumulator.
+
+    o: (B,H,Tq,D) weighted sum; l: (B,H,Tq) denom; m: (B,H,Tq) running max;
+    s: (B,H,Tq,Tk) scores; v: (B,H,Tk,D).
+    """
+    import jax.numpy as jnp
+
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN
+    m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    correction = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF,
+                                   m - m_safe))
+    correction = jnp.where(m <= _NEG_INF / 2, 0.0, correction)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v)
+    return o_new, l_new, m_new
+
+
+def _local_scores(q, k, scale, causal, q_off, k_off):
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        qpos = q_off + jnp.arange(Tq)
+        kpos = k_off + jnp.arange(Tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    return s
+
+
+def ring_attention(q, k, v, mesh=None, axis=SP, causal=False, scale=None):
+    """Attention with the sequence dim sharded on `axis`.
+
+    q,k,v: GLOBAL arrays (B, H, T, D) laid out with T sharded on `axis`.
+    Returns the attention output with the same sharding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = mesh or default_mesh()
+    if mesh is None:
+        raise MXNetError("ring_attention needs a mesh (pass mesh= or "
+                         "parallel.set_default_mesh)")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nshards = mesh.shape.get(axis, 1)
+    # compose with data parallelism: batch dim stays dp-sharded inside the
+    # manual region when the mesh has a dp axis
+    batch_ax = "dp" if "dp" in mesh.shape else None
+    spec = PartitionSpec(batch_ax, None, axis, None)
+    (q, k, v), eager = _place(mesh, spec, q, k, v)
+
+    def local(q, k, v):
+        p = nshards
+        i = lax.axis_index(axis)
+        B, H, Tq, D = q.shape
+        o = _pvary(jnp.zeros_like(q, dtype=jnp.float32), axis)
+        l = _pvary(jnp.zeros((B, H, Tq), jnp.float32), axis)
+        m = _pvary(jnp.full((B, H, Tq), _NEG_INF, jnp.float32), axis)
+        Tk = k.shape[2]
+        perm = [(r, (r + 1) % p) for r in range(p)]
+
+        def body(step, carry):
+            o, l, m, k, v = carry
+            j = (i - step) % p          # which global KV block we hold now
+            s = _local_scores(q.astype(jnp.float32),
+                              k.astype(jnp.float32), scale, causal,
+                              i * Tq, j * Tk)
+            o, l, m = _online_block(o, l, m, s, v.astype(jnp.float32))
+            k = lax.ppermute(k, axis, perm)
+            v = lax.ppermute(v, axis, perm)
+            return o, l, m, k, v
+
+        o, l, m, k, v = lax.fori_loop(0, p, body, (o, l, m, k, v))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (o / l[..., None]).astype(q.dtype)
+
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return _uncommit(fn(q, k, v), eager)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis=SP, causal=False,
+                      scale=None):
+    """All-to-all head↔sequence resharding attention (DeepSpeed-Ulysses).
+
+    q,k,v: (B, H, T, D) with T sharded on `axis`; H must be divisible by
+    the axis size.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    import jax
+
+    mesh = mesh or default_mesh()
+    if mesh is None:
+        raise MXNetError("ulysses_attention needs a mesh")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nshards = mesh.shape.get(axis, 1)
+    if q.shape[1] % nshards != 0:
+        raise MXNetError(
+            f"ulysses: num_heads {q.shape[1]} not divisible by sp size "
+            f"{nshards}")
+    batch_ax = "dp" if "dp" in mesh.shape else None
+    spec = PartitionSpec(batch_ax, None, axis, None)
+    (q, k, v), eager = _place(mesh, spec, q, k, v)
+
+    def local(q, k, v):
+        # (B, H, T/p, D) → (B, H/p, T, D): gather sequence, scatter heads
+        def seq2head(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def head2seq(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf.astype(jnp.float32),
+                       kf.astype(jnp.float32)) * scale
+        if causal:
+            T = s.shape[-1]
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        of = jnp.einsum("bhqk,bhkd->bhqd", p,
+                        vf.astype(jnp.float32)).astype(q.dtype)
+        return head2seq(of)
+
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return _uncommit(fn(q, k, v), eager)

@@ -1,0 +1,80 @@
+"""Parameter sharding rules (tensor parallelism).
+
+NEW, TPU-first (SURVEY.md §2.5: TP is absent in the reference).  A rule set
+maps parameter-name regexes to ``PartitionSpec``s; ``pjit``/GSPMD inserts
+the Megatron collectives from the annotations alone — no hand-written
+all-reduces in layer code.
+
+Megatron recipe on (out, in)-layout weights (our FullyConnected keeps the
+reference layout, fully_connected.cc):
+- column-parallel (shard OUTPUT dim, spec ('tp', None)): QKV projections,
+  FFN up-projection, embedding vocab dim;
+- row-parallel (shard INPUT dim, spec (None, 'tp')): attention output
+  projection, FFN down-projection — its products need one psum, which GSPMD
+  emits where the annotations meet.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .mesh import TP
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec tuple) rules; first match wins."""
+
+    def __init__(self, rules=(), default=()):
+        self._rules = [(re.compile(p), spec) for p, spec in rules]
+        self._default = tuple(default)
+
+    def spec_for(self, name, shape=None):
+        from jax.sharding import PartitionSpec
+
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return PartitionSpec(*spec)
+        return PartitionSpec(*self._default)
+
+    def add(self, pattern, spec):
+        self._rules.append((re.compile(pattern), tuple(spec)))
+        return self
+
+
+# default rule set for the transformer family (gluon/model_zoo/bert.py
+# parameter names)
+TRANSFORMER_TP_RULES = ShardingRules(rules=[
+    (r"(query|key|value|qkv)_weight$", (TP, None)),   # column-parallel
+    (r"(query|key|value|qkv)_bias$", (TP,)),
+    (r"proj_weight$", (None, TP)),                    # row-parallel
+    (r"ffn1_weight$", (TP, None)),
+    (r"ffn1_bias$", (TP,)),
+    (r"ffn2_weight$", (None, TP)),
+    (r"word_embed_weight$|embedding\d*_weight$", (TP, None)),
+], default=())
+
+
+def annotate_block(block, rules):
+    """Stamp partition_spec onto every Parameter of a block (consumed by
+    ShardedTrainer when laying params over the mesh)."""
+    for name, param in block.collect_params().items():
+        param.partition_spec = rules.spec_for(name, param.shape)
+    return block
+
+
+def param_sharding(param, mesh):
+    """NamedSharding for a Parameter (replicated when no spec/axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = param.partition_spec
+    if spec is None:
+        spec = PartitionSpec()
+    # drop axes the mesh doesn't have (lets the same rules run on a
+    # dp-only mesh)
+    cleaned = []
+    for entry in tuple(spec):
+        if entry is None or entry in mesh.shape:
+            cleaned.append(entry)
+        else:
+            cleaned.append(None)
+    return NamedSharding(mesh, PartitionSpec(*cleaned))

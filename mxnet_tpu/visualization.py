@@ -1,0 +1,82 @@
+"""Network visualization.
+
+Reference parity: python/mxnet/visualization.py — print_summary (per-layer
+params table) and plot_network (graphviz).  Here summary introspects gluon
+Blocks; plot_network renders the jaxpr of a hybridized block when graphviz
+is available and degrades to text otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+
+def block_summary(block, *inputs):
+    """Per-layer summary of a gluon Block (reference: Block.summary)."""
+    summary = []
+
+    def walk(blk, name, depth):
+        n_params = 0
+        for p in blk._reg_params.values():
+            try:
+                n_params += int(_np.prod(p.shape))
+            except Exception:
+                pass
+        summary.append((name or blk.name, type(blk).__name__, n_params,
+                        depth))
+        for child_name, child in blk._children.items():
+            walk(child, f"{name}.{child_name}" if name else child_name,
+                 depth + 1)
+
+    walk(block, "", 0)
+    total = 0
+    lines = [f"{'Layer':<44}{'Type':<24}{'Params':>12}",
+             "-" * 80]
+    for name, tname, n, depth in summary:
+        total += n
+        lines.append(f"{'  ' * depth + (name or tname):<44}{tname:<24}"
+                     f"{n:>12}")
+    lines.append("-" * 80)
+    lines.append(f"{'Total params':<68}{total:>12}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def print_summary(symbol_or_block, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Reference: mx.viz.print_summary."""
+    from .gluon.block import Block
+
+    if isinstance(symbol_or_block, Block):
+        return block_summary(symbol_or_block)
+    # symbol path: walk graph nodes
+    sym = symbol_or_block
+    lines = [f"{'Op':<40}{'Name':<40}", "-" * 80]
+    for node in sym.list_nodes():
+        lines.append(f"{node.get('op', 'null'):<40}"
+                     f"{node.get('name', ''):<40}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Reference: mx.viz.plot_network (graphviz).  Degrades to a text
+    rendering when graphviz is unavailable (zero-egress image)."""
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        return print_summary(symbol)
+    dot = graphviz.Digraph(name=title)
+    for node in symbol.list_nodes():
+        op = node.get("op", "null")
+        name = node.get("name", "")
+        if hide_weights and op == "null" and (
+                name.endswith("weight") or name.endswith("bias")):
+            continue
+        dot.node(name, f"{op}\n{name}")
+        for src in node.get("inputs", []):
+            dot.edge(str(src), name)
+    return dot

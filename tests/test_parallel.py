@@ -1,0 +1,229 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4:
+the analog of the reference's fake-multi-node local tracker)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ops.attention import scaled_dot_product_attention
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(2, 8, 64, 16).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_mesh_axes():
+    mesh = parallel.make_mesh(dp=4, tp=2)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    with pytest.raises(Exception):
+        parallel.make_mesh(dp=100)
+
+
+def test_ring_attention_matches_dense(qkv):
+    q, k, v = qkv
+    mesh = parallel.make_mesh(sp=8)
+    dense = scaled_dot_product_attention(q, k, v)
+    ring = parallel.ring_attention(q, k, v, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_causal(qkv):
+    q, k, v = qkv
+    mesh = parallel.make_mesh(sp=8)
+    dense = scaled_dot_product_attention(q, k, v, causal=True)
+    ring = parallel.ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_differentiable(qkv):
+    q, k, v = qkv
+    mesh = parallel.make_mesh(sp=8)
+
+    def loss_ring(q):
+        return jnp.sum(parallel.ring_attention(q, k, v, mesh=mesh) ** 2)
+
+    def loss_dense(q):
+        return jnp.sum(scaled_dot_product_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring)(q)
+    g_dense = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(g_dense), np.asarray(g_ring),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_ulysses_matches_dense(qkv):
+    q, k, v = qkv
+    mesh = parallel.make_mesh(sp=8)
+    dense = scaled_dot_product_attention(q, k, v, causal=True)
+    uly = parallel.ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(uly),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_interpret(qkv):
+    from mxnet_tpu.ops.pallas_attention import flash_attention
+
+    q, k, v = qkv
+    for causal in (False, True):
+        dense = scaled_dot_product_attention(q, k, v, causal=causal)
+        fl = flash_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(fl),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grad(qkv):
+    from mxnet_tpu.ops.pallas_attention import flash_attention
+
+    q, k, v = qkv
+
+    def f(q):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def f_ref(q):
+        return jnp.sum(scaled_dot_product_attention(q, k, v) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)),
+                               np.asarray(jax.grad(f_ref)(q)),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_sharded_trainer_dp_matches_single_device():
+    """DP training over 8 shards must match the same model trained
+    locally (the CPU↔TPU consistency oracle, SURVEY §4)."""
+    def build():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential(prefix="m_")
+        with net.name_scope():
+            # in_units given → immediate (not deferred) init, so both
+            # builds draw identical weights from the reseeded RNG
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(4, in_units=16))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    x = np.random.RandomState(1).randn(32, 8).astype(np.float32)
+    y = (np.arange(32) % 4).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # sharded: dp=8 mesh
+    net_a = build()
+    tr_a = parallel.ShardedTrainer(net_a, loss_fn, "sgd",
+                                   {"learning_rate": 0.1},
+                                   mesh=parallel.make_mesh(dp=8))
+    # local single-logical-device via gluon.Trainer
+    net_b = build()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1})
+    for _ in range(3):
+        tr_a.step(x, y)
+        with mx.autograd.record():
+            loss = loss_fn(net_b(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        tr_b.step(32)
+    tr_a.sync_params()
+    wa = net_a[0].weight.data().asnumpy()
+    wb = net_b[0].weight.data().asnumpy()
+    np.testing.assert_allclose(wa, wb, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_trainer_tp_rules_shard_params():
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    mesh = parallel.make_mesh(dp=4, tp=2)
+    net = bert.bert_tiny()
+    net.initialize(init=mx.init.Xavier())
+    tr = parallel.ShardedTrainer(net, bert.BERTPretrainLoss(), "adam",
+                                 {"learning_rate": 1e-3}, mesh=mesh,
+                                 rules=parallel.TRANSFORMER_TP_RULES)
+    rng = np.random.RandomState(0)
+    B, T = 8, 32
+    ids = rng.randint(0, 1024, (B, T)).astype(np.int32)
+    mlm = np.where(rng.rand(B, T) < 0.15, ids, -1).astype(np.float32)
+    nsp = rng.randint(0, 2, (B,)).astype(np.float32)
+    l0 = tr.step(ids, (mx.nd.array(mlm), mx.nd.array(nsp)))
+    l1 = tr.step(ids, (mx.nd.array(mlm), mx.nd.array(nsp)))
+    assert np.isfinite(float(l1.asscalar()))
+    specs = {n: v.sharding.spec for (n, _), v in
+             zip(tr._trainable, tr._param_vals)}
+    qkv = [s for n, s in specs.items() if "qkv_weight" in n]
+    assert all(tuple(s) and s[0] == "tp" for s in qkv), qkv
+    ffn2 = [s for n, s in specs.items() if "ffn2_weight" in n]
+    assert all(len(tuple(s)) >= 2 and s[1] == "tp" for s in ffn2), ffn2
+
+
+def test_pipeline_apply_matches_sequential():
+    mesh = parallel.make_mesh(pp=8)
+    feat = 8
+    rng = np.random.RandomState(0)
+    stages = [{"w": jnp.asarray(rng.randn(feat, feat).astype(np.float32)
+                                * 0.3),
+               "b": jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)}
+              for _ in range(8)]
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    stacked = parallel.stack_stage_params(stages)
+    stacked = jax.device_put(
+        stacked, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("pp")))
+    x_micro = jnp.asarray(rng.randn(16, 4, feat).astype(np.float32))
+    out = parallel.pipeline_apply(stage_fn, stacked, x_micro, mesh=mesh)
+
+    ref = x_micro
+    for p in stages:
+        ref = jnp.tanh(ref @ p["w"] + p["b"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_collectives_allreduce():
+    mesh = parallel.make_mesh(dp=8)
+    x = jax.device_put(
+        jnp.arange(16.0),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec("dp")))
+    out = parallel.collectives.allreduce(x, mesh)
+    total = np.asarray(out)
+    # psum over shards: every shard position holds the sum of its peers
+    expected = np.arange(16.0).reshape(8, 2).sum(axis=0)
+    np.testing.assert_allclose(total[:2], expected)
+
+
+def test_bandwidth_tool_runs():
+    mesh = parallel.make_mesh(dp=8)
+    bw = parallel.collectives.measure_allreduce_bandwidth(
+        mesh, size_mb=1, iters=2)
+    assert bw > 0
+
+
+def test_bert_ring_attention_model():
+    """BERT with attention_impl='ring' trains on an sp mesh."""
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    mesh = parallel.make_mesh(sp=4)
+    parallel.set_default_mesh(mesh)
+    net = bert.bert_tiny(attention_impl="ring", use_decoder=False,
+                         use_pooler=False)
+    net.initialize(init=mx.init.Xavier())
+    ids = mx.nd.array(np.random.randint(0, 1024, (2, 32))
+                      .astype(np.float32))
+    out = net(ids)
+    assert out.shape == (2, 32, 64)
+    dense_net = bert.bert_tiny(attention_impl="dense", use_decoder=False,
+                               use_pooler=False,
+                               params=net.collect_params())
+    out2 = dense_net(ids)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=2e-3,
+                               atol=2e-4)
