@@ -45,7 +45,8 @@ import importlib as _importlib
 
 for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "gluon", "io", "recordio", "image", "profiler", "runtime",
-             "parallel", "test_utils", "util", "visualization", "operator"):
+             "parallel", "test_utils", "util", "visualization", "operator",
+             "symbol", "model", "module", "lr_scheduler", "distributed"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:
@@ -61,3 +62,9 @@ if "initializer" in globals():
     init = globals()["initializer"]
 if "optimizer" in globals():
     lr_scheduler = optimizer.lr_scheduler
+if "symbol" in globals():
+    sym = globals()["symbol"]
+if "module" in globals():
+    mod = globals()["module"]
+if "visualization" in globals():
+    viz = globals()["visualization"]

@@ -1,0 +1,180 @@
+"""ctypes loader for the native C++ library.
+
+Reference parity: python/mxnet/base.py's ``_LIB`` dll loading — the FFI
+boundary of the rebuild (SURVEY.md L5).  The library is optional: every
+consumer has a pure-python fallback, so an unbuilt tree still works
+(``make -C src`` builds it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB = None
+_TRIED = False
+
+
+def lib():
+    """Return the loaded native library or None."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [
+        os.path.join(here, "src", "libmxtpu_io.so"),
+        os.path.join(here, "libmxtpu_io.so"),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            try:
+                _LIB = ctypes.CDLL(path)
+                _declare(_LIB)
+                break
+            except OSError:
+                _LIB = None
+    return _LIB
+
+
+def _declare(L):
+    c = ctypes
+    L.mxtpu_recio_open_read.restype = c.c_void_p
+    L.mxtpu_recio_open_read.argtypes = [c.c_char_p]
+    L.mxtpu_recio_close_read.argtypes = [c.c_void_p]
+    L.mxtpu_recio_scan.restype = c.c_int64
+    L.mxtpu_recio_scan.argtypes = [c.c_void_p,
+                                   c.POINTER(c.POINTER(c.c_int64))]
+    L.mxtpu_recio_read_at.restype = c.c_int64
+    L.mxtpu_recio_read_at.argtypes = [c.c_void_p, c.c_int64,
+                                      c.POINTER(c.POINTER(c.c_char))]
+    L.mxtpu_free.argtypes = [c.POINTER(c.c_char)]
+    L.mxtpu_free_i64.argtypes = [c.POINTER(c.c_int64)]
+    L.mxtpu_recio_open_write.restype = c.c_void_p
+    L.mxtpu_recio_open_write.argtypes = [c.c_char_p, c.c_int]
+    L.mxtpu_recio_write.restype = c.c_int64
+    L.mxtpu_recio_write.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    L.mxtpu_recio_close_write.argtypes = [c.c_void_p]
+    L.mxtpu_prefetcher_create.restype = c.c_void_p
+    L.mxtpu_prefetcher_create.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                          c.c_uint64]
+    L.mxtpu_prefetcher_size.restype = c.c_int64
+    L.mxtpu_prefetcher_size.argtypes = [c.c_void_p]
+    L.mxtpu_prefetcher_next.restype = c.c_int64
+    L.mxtpu_prefetcher_next.argtypes = [c.c_void_p,
+                                        c.POINTER(c.POINTER(c.c_char))]
+    L.mxtpu_prefetcher_reset.argtypes = [c.c_void_p, c.c_uint64]
+    L.mxtpu_prefetcher_destroy.argtypes = [c.c_void_p]
+
+
+class NativeRecordReader:
+    """Random-access reader over the native codec."""
+
+    def __init__(self, path):
+        L = lib()
+        if L is None:
+            raise OSError("native library not built (make -C src)")
+        self._L = L
+        self._h = L.mxtpu_recio_open_read(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+
+    def scan(self):
+        ptr = ctypes.POINTER(ctypes.c_int64)()
+        n = self._L.mxtpu_recio_scan(self._h, ctypes.byref(ptr))
+        if n < 0:
+            raise OSError("corrupt record file")
+        out = [ptr[i] for i in range(n)]
+        self._L.mxtpu_free_i64(ptr)
+        return out
+
+    def read_at(self, offset):
+        ptr = ctypes.POINTER(ctypes.c_char)()
+        n = self._L.mxtpu_recio_read_at(self._h, offset,
+                                        ctypes.byref(ptr))
+        if n < 0:
+            raise OSError("read failed")
+        data = ctypes.string_at(ptr, n)
+        self._L.mxtpu_free(ptr)
+        return data
+
+    def close(self):
+        if self._h:
+            self._L.mxtpu_recio_close_read(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    def __init__(self, path, append=False):
+        L = lib()
+        if L is None:
+            raise OSError("native library not built (make -C src)")
+        self._L = L
+        self._h = L.mxtpu_recio_open_write(path.encode(),
+                                           1 if append else 0)
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+
+    def write(self, data):
+        return self._L.mxtpu_recio_write(self._h, data, len(data))
+
+    def close(self):
+        if self._h:
+            self._L.mxtpu_recio_close_write(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetcher:
+    """Threaded record prefetcher (dmlc::ThreadedIter analog)."""
+
+    def __init__(self, path, n_threads=4, shuffle=False, seed=0):
+        L = lib()
+        if L is None:
+            raise OSError("native library not built (make -C src)")
+        self._L = L
+        self._h = L.mxtpu_prefetcher_create(path.encode(), n_threads,
+                                            1 if shuffle else 0, seed)
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+
+    def __len__(self):
+        return self._L.mxtpu_prefetcher_size(self._h)
+
+    def next(self):
+        ptr = ctypes.POINTER(ctypes.c_char)()
+        n = self._L.mxtpu_prefetcher_next(self._h, ctypes.byref(ptr))
+        if n < 0:
+            return None
+        data = ctypes.string_at(ptr, n)
+        self._L.mxtpu_free(ptr)
+        return data
+
+    def reset(self, seed=0):
+        self._L.mxtpu_prefetcher_reset(self._h, seed)
+
+    def close(self):
+        if self._h:
+            self._L.mxtpu_prefetcher_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def available():
+    return lib() is not None

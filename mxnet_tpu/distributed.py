@@ -1,0 +1,67 @@
+"""Multi-host process bootstrap.
+
+Reference parity: ps-lite's Postoffice/Van rendezvous + the dmlc tracker
+env contract (DMLC_ROLE/DMLC_PS_ROOT_URI/DMLC_NUM_WORKER, SURVEY.md §5.6
+plane 4).
+
+TPU-native: one coordinator rendezvous via ``jax.distributed.initialize``;
+the env contract is MXTPU_COORDINATOR / MXTPU_NUM_WORKERS /
+MXTPU_WORKER_RANK, set by tools/launch.py.  After init, every process sees
+the global device set and collectives span hosts over ICI/DCN
+automatically.  Checkpoint-restart is the recovery primitive (SURVEY.md
+§5.3: elasticity is out of scope, matching the reference).
+"""
+
+from __future__ import annotations
+
+import os
+
+_INITIALIZED = False
+
+
+def init_from_env():
+    """Join the rendezvous if launch env vars are present; no-op
+    otherwise.  Returns True if running distributed."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
+        process_id=int(os.environ["MXTPU_WORKER_RANK"]))
+    _INITIALIZED = True
+    return True
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None):
+    """Explicit init (reference analog: ps::Postoffice::Start)."""
+    global _INITIALIZED
+    import jax
+
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+    _INITIALIZED = True
+
+
+def rank():
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+
+    return jax.process_count()
+
+
+def barrier(name="mxtpu_barrier"):
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

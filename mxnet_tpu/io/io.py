@@ -592,19 +592,32 @@ class ImageRecordIter(DataIter):
         self._img = img_mod
         # index pass: record OFFSETS only (payloads stream per batch — the
         # reference's parser also reads chunks on demand, iter_image_
-        # recordio_2.cc)
-        self._offsets = []
-        while True:
-            pos = self._rec.tell()
-            rec = self._rec.read()
-            if rec is None:
-                break
-            self._offsets.append(pos)
+        # recordio_2.cc).  Native C++ codec (src/recordio.cc) is the fast
+        # path; the python codec is the fallback.
+        self._native = None
+        try:
+            from .. import _native
+
+            if _native.available():
+                self._native = _native.NativeRecordReader(path_imgrec)
+                self._offsets = self._native.scan()
+        except OSError:
+            self._native = None
+        if self._native is None:
+            self._offsets = []
+            while True:
+                pos = self._rec.tell()
+                rec = self._rec.read()
+                if rec is None:
+                    break
+                self._offsets.append(pos)
         self._order = _np.arange(len(self._offsets))
         self.cursor = 0
         self.reset()
 
     def _read_at(self, offset):
+        if self._native is not None:
+            return self._native.read_at(offset)
         self._rec.seek(offset)
         return self._rec.read()
 

@@ -37,6 +37,10 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._is_dist = kv_type.startswith("dist")
+        if self._is_dist:
+            from . import distributed
+
+            distributed.init_from_env()
 
     # -- identity --------------------------------------------------------------
     @property

@@ -1,0 +1,51 @@
+"""Model checkpoint helpers.
+
+Reference parity: python/mxnet/model.py — save_checkpoint/load_checkpoint
+(the prefix-symbol.json + prefix-NNNN.params deploy pair) and the
+BatchEndParam callback bundle.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .ndarray import load as nd_load
+from .ndarray import save as nd_save
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Reference: mx.model.save_checkpoint."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{name}": v for name, v in arg_params.items()}
+    save_dict.update({f"aux:{name}": v for name, v in aux_params.items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference: mx.model.load_checkpoint → (symbol, arg_params,
+    aux_params)."""
+    from . import symbol as sym_mod
+
+    import os
+
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym_mod.load(f"{prefix}-symbol.json")
+    save_dict = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,4 @@
+"""Legacy Module API (reference: python/mxnet/module/)."""
+
+from .base_module import BaseModule
+from .module import BucketingModule, Module

@@ -1,0 +1,288 @@
+"""Module: symbolic training on a bound executor.
+
+Reference parity: python/mxnet/module/module.py — bind/init_params/
+init_optimizer/forward/backward/update/get_params/save_checkpoint.
+
+TPU-first: one executor per module (the whole graph is one XLA program).
+The reference's DataParallelExecutorGroup (one executor per GPU +
+kvstore reduce) is superseded by mesh sharding — run Module inside
+``parallel.make_mesh(dp=N)`` shardings, or use parallel.ShardedTrainer for
+the compiled multi-chip step.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..model import load_checkpoint, save_checkpoint
+from ..ndarray.ndarray import NDArray, _from_jax
+from .base_module import BaseModule
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Reference: Module.bind → GraphExecutor::Init."""
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = shape
+        if label_shapes:
+            for desc in label_shapes:
+                shapes[desc[0]] = desc[1]
+        self._batch_size = data_shapes[0][1][0]
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names or n in self._label_names or \
+                    n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req
+        self._exec = self._symbol.simple_bind(grad_req=req, **shapes)
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Reference: Module.init_params."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._set_data(arg_params[name]._data)
+            else:
+                if arg_params and not allow_missing and arg_params:
+                    raise MXNetError(f"parameter {name} missing")
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg_params = {n: self._exec.arg_dict[n].copy()
+                      for n in self._param_names}
+        aux_params = {n: v.copy() for n, v in self._exec.aux_dict.items()}
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Reference: Module.init_optimizer (+ kvstore wiring)."""
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                # reference behavior: normalize summed grads by batch size
+                optimizer_params["rescale_grad"] = \
+                    1.0 / getattr(self, "_batch_size", 1)
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if kvstore:
+            from .. import kvstore as kv_mod
+
+            kv = kv_mod.create(kvstore) if isinstance(kvstore, str) \
+                else kvstore
+            if kv.num_workers > 1 or kv.type.startswith("dist"):
+                self._kvstore = kv
+                for i, name in enumerate(self._param_names):
+                    kv.init(i, self._exec.arg_dict[name])
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = dict(zip(self._data_names, data_batch.data))
+        if data_batch.label is not None and self._label_names:
+            feed.update(zip(self._label_names, data_batch.label))
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Reference: Module.update — kvstore reduce + fused updater."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if self._kvstore is not None:
+                self._kvstore.pushpull(i, grad, out=grad)
+            self._updater(i, grad, weight)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._exec.outputs)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params,
+                        aux_params)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        mod._preloaded_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        return mod
+
+
+class BucketingModule(BaseModule):
+    """Per-bucket Modules sharing parameters (reference:
+    python/mxnet/module/bucketing_module.py — variable-length batching).
+
+    On TPU, per-bucket graphs are per-shape XLA programs: binding a bucket
+    is just another jit signature, so this stays cheap.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger=logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._kwargs = kwargs
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    def _get_module(self, bucket_key):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            self._buckets[bucket_key] = Module(
+                sym, data_names=data_names, label_names=label_names,
+                **self._kwargs)
+        return self._buckets[bucket_key]
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        mod = self._get_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes,
+                     for_training=self.for_training)
+            if self._curr_module is not None and \
+                    self._curr_module.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                allow_missing=False, force_init=True)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        self.for_training = for_training
+        self.switch_bucket(self._default_bucket_key, data_shapes,
+                           label_shapes)
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._opt_kwargs = kwargs
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key if data_batch.bucket_key is not None \
+            else self._default_bucket_key
+        if key != self._curr_bucket_key:
+            prev = self._curr_module
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+            if not self._curr_module.params_initialized and prev:
+                arg, aux = prev.get_params()
+                self._curr_module.init_params(arg_params=arg,
+                                              aux_params=aux,
+                                              force_init=True)
+            if not self._curr_module.optimizer_initialized and \
+                    self.optimizer_initialized:
+                self._curr_module.init_optimizer(**self._opt_kwargs)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None

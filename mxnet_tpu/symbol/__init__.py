@@ -1,0 +1,8 @@
+"""Symbolic API (reference: python/mxnet/symbol/)."""
+
+from .symbol import (Group, Symbol, Variable, apply_op, fromjson, load,
+                     trace_block, var)
+from .executor import Executor
+from . import register as _register
+
+_register.populate(globals())

@@ -1,0 +1,146 @@
+"""Graph executor.
+
+Reference parity: src/executor/graph_executor.cc + python/mxnet/executor.py
+— Executor with arg_arrays/grad_arrays/aux_states, forward/backward,
+outputs, copy_params_from.
+
+TPU-first: "binding" jit-compiles the whole graph once per shape signature
+(forward AND backward as single XLA programs) — the reference's
+InferShape→PlanMemory→AttachOpExecs pipeline is the XLA compiler.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _from_jax
+
+
+class Executor:
+    def __init__(self, symbol, args, args_grad=None, grad_req="write",
+                 aux_states=None, ctx=None):
+        self._symbol = symbol
+        self._arg_names = symbol.list_arguments()
+        self.arg_dict = dict(args) if args else {}
+        for name in self._arg_names:
+            if name not in self.arg_dict:
+                raise MXNetError(f"missing argument {name} in bind")
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = grad_req
+        if args_grad is None:
+            import jax.numpy as jnp
+
+            args_grad = {n: _from_jax(jnp.zeros_like(self.arg_dict[n]._data))
+                         for n in self._arg_names
+                         if grad_req.get(n, "null") != "null"}
+        elif isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._arg_names, args_grad))
+        self.grad_dict = args_grad
+        self.grad_arrays = [self.grad_dict.get(n)
+                            for n in self._arg_names]
+        self.aux_dict = dict(aux_states) if aux_states else {}
+        self.aux_arrays = list(self.aux_dict.values())
+        self.outputs = []
+        self._fwd_jit = None
+        self._grad_jit = None
+
+    def _build(self):
+        import jax
+
+        sym = self._symbol
+        names = self._arg_names
+        grad_names = [n for n in names
+                      if self._grad_req.get(n, "null") != "null"]
+        g_idx = [names.index(n) for n in grad_names]
+
+        def fwd(vals):
+            env = dict(zip(names, vals))
+            return sym.eval_raw(**env)
+
+        self._fwd_jit = jax.jit(fwd)
+
+        def loss_like(vals, out_ct):
+            out = fwd(vals)
+            if isinstance(out, (tuple, list)):
+                return sum((o * c).sum() for o, c in zip(out, out_ct))
+            return (out * out_ct).sum()
+
+        self._grad_jit = jax.jit(jax.grad(loss_like))
+        self._g_idx = g_idx
+
+    def forward(self, is_train=False, **kwargs):
+        """Reference: Executor.forward — optionally update args from
+        kwargs, run the compiled graph."""
+        from .. import autograd as _ag
+
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else v)
+        if self._fwd_jit is None:
+            self._build()
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        with (_ag.train_mode() if is_train else _ag.predict_mode()):
+            out = self._fwd_jit(vals)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self.outputs = [_from_jax(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Reference: Executor.backward — grads into grad_arrays honoring
+        grad_req write/add."""
+        import jax.numpy as jnp
+
+        if self._grad_jit is None:
+            self._build()
+        if not self.outputs:
+            raise MXNetError("call forward before backward")
+        if out_grads is None:
+            out_ct = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_ct = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        grads = self._grad_jit(vals, tuple(out_ct)
+                               if len(out_ct) > 1 else out_ct[0])
+        for n, g in zip(self._arg_names, grads):
+            req = self._grad_req.get(n, "null")
+            if req == "null" or self.grad_dict.get(n) is None:
+                continue
+            tgt = self.grad_dict[n]
+            if req == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(array._data)
+            elif not allow_extra_params:
+                raise ValueError(f"Found name '{name}' that is not in the "
+                                 "arguments")
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(array._data)
+                elif not allow_extra_params:
+                    raise ValueError(f"Found name '{name}' that is not in "
+                                     "auxiliary states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new shapes (XLA recompiles per signature anyway)."""
+        import jax.numpy as jnp
+
+        new_args = {}
+        for n in self._arg_names:
+            shape = kwargs.get(n, self.arg_dict[n].shape)
+            new_args[n] = _from_jax(jnp.zeros(shape, jnp.float32))
+        return Executor(self._symbol, new_args, grad_req=self._grad_req)

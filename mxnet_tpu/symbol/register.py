@@ -1,0 +1,28 @@
+"""Generated symbol op wrappers (reference: python/mxnet/symbol/register.py
+— import-time codegen over the op registry, mirroring the ndarray side)."""
+
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import Symbol, apply_op
+
+
+def _make_wrapper(opname):
+    def wrapper(*args, name=None, **kwargs):
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        non_sym = [a for a in args if not isinstance(a, Symbol)]
+        if non_sym and not sym_args:
+            raise TypeError(
+                f"symbol op {opname} expects Symbol inputs; for arrays use "
+                f"mx.nd.{opname}")
+        return apply_op(opname, *args, name=name, **kwargs)
+
+    wrapper.__name__ = opname
+    wrapper.__doc__ = f"(symbol wrapper for op '{opname}')"
+    return wrapper
+
+
+def populate(namespace):
+    for opname in _registry.all_ops():
+        if opname not in namespace:
+            namespace[opname] = _make_wrapper(opname)

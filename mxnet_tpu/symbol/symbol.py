@@ -1,0 +1,509 @@
+"""Symbolic graph construction.
+
+Reference parity: python/mxnet/symbol/ + 3rdparty nnvm Symbol/Graph
+(include/nnvm/symbolic.h) — mx.sym.Variable, generated op symbols,
+list_arguments/list_outputs/infer_shape, tojson/load, bind/simple_bind,
+Symbol.eval, Group.
+
+TPU-first redesign: a Symbol is a lightweight Python DAG over the SAME op
+registry the imperative API uses; "binding" compiles the whole graph with
+``jax.jit`` (shape inference = jax.eval_shape — no hand-written FInferShape
+pass).  The JSON format keeps the reference's structural layout
+({'nodes': [...], 'arg_nodes': [...], 'heads': [...]}) so exported
+symbol.json files are recognizable and round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+_SYM_COUNTER = [0]
+
+
+def _auto_name(hint):
+    _SYM_COUNTER[0] += 1
+    return f"{hint.lower()}{_SYM_COUNTER[0] - 1}"
+
+
+class Symbol:
+    """One output of a graph node (reference: nnvm NodeEntry + Symbol)."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "out_index", "_n_outputs",
+                 "_attr_dict")
+
+    def __init__(self, op, name, inputs, attrs, out_index=0, n_outputs=1):
+        self.op = op                  # None for variables
+        self.name = name
+        self.inputs = inputs          # list[Symbol]
+        self.attrs = attrs            # op kwargs (json-serializable)
+        self.out_index = out_index
+        self._n_outputs = n_outputs
+        self._attr_dict = {}
+
+    # -- construction ----------------------------------------------------------
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __copy__(self):
+        return Symbol(self.op, self.name, list(self.inputs),
+                      dict(self.attrs), self.out_index, self._n_outputs)
+
+    def attr(self, key):
+        return self._attr_dict.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._attr_dict.update(kwargs)
+
+    def __getitem__(self, index):
+        if isinstance(index, int):
+            if self._n_outputs == 1 and index == 0:
+                return self
+            return Symbol(self.op, self.name, self.inputs, self.attrs,
+                          out_index=index, n_outputs=self._n_outputs)
+        raise MXNetError("Symbol only supports integer indexing")
+
+    # arithmetic via registered broadcast ops
+    def _binop(self, other, opname, reverse=False):
+        if not isinstance(other, Symbol):
+            other = _scalar_sym(other)
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(opname, a, b)
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __neg__(self):
+        return apply_op("negative", self)
+
+    # -- graph introspection ---------------------------------------------------
+
+    def _topo(self):
+        order, seen = [], set()
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            pending = [i for i in node.inputs if id(i) not in seen]
+            if pending:
+                stack.append(node)
+                # reversed → leftmost input resolves first (reference
+                # argument ordering: data before weights before labels)
+                stack.extend(reversed(pending))
+            else:
+                seen.add(id(node))
+                order.append(node)
+        return order
+
+    def list_arguments(self):
+        """Free variables in topo order, aux excluded (reference:
+        Symbol.list_arguments)."""
+        return [n.name for n in self._topo()
+                if n.op is None and not n._attr_dict.get("__aux__")]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo()
+                if n.op is None and n._attr_dict.get("__aux__")]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self):
+        if self._n_outputs == 1:
+            return [f"{self.name}_output"]
+        return [f"{self.name}_output{i}" for i in range(self._n_outputs)]
+
+    def get_internals(self):
+        return Group([_as_single(n) for n in self._topo()
+                      if n.op is not None])
+
+    def list_nodes(self):
+        """JSON-style node dicts (used by visualization)."""
+        return json.loads(self.tojson())["nodes"]
+
+    # -- evaluation ------------------------------------------------------------
+
+    def _eval_node(self, node, env, cache):
+        # keyed by node NAME: s and s[1] are distinct Symbol objects viewing
+        # the same graph node, and must share one op evaluation
+        key = node.name
+        if key in cache:
+            return cache[key]
+        if node.op is None:
+            if "__scalar__" in node.attrs:
+                val = node.attrs["__scalar__"]
+            elif node.name in env:
+                val = env[node.name]
+            else:
+                raise MXNetError(f"unbound variable {node.name}")
+        else:
+            args = []
+            for i in node.inputs:
+                v = self._eval_node(i, env, cache)
+                if isinstance(v, (tuple, list)):
+                    v = v[i.out_index]
+                args.append(v)
+            opdef = _registry.get(node.op)
+            val = opdef.fn(*args, **node.attrs)
+        cache[key] = val
+        return val
+
+    def eval_raw(self, **env):
+        """Evaluate on raw jax arrays (jit-able)."""
+        out = self._eval_node(self, env, {})
+        if isinstance(out, tuple):
+            return out[self.out_index]
+        return out
+
+    def eval(self, ctx=None, **kwargs):
+        """Reference: Symbol.eval — bind variables, return NDArray(s)."""
+        from ..ndarray.ndarray import NDArray, _from_jax
+
+        env = {k: (v._data if isinstance(v, NDArray) else v)
+               for k, v in kwargs.items()}
+        out = self.eval_raw(**env)
+        return _from_jax(out)
+
+    def infer_shape(self, **kwargs):
+        """Shape inference: forward abstract evaluation per node via
+        jax.eval_shape (replacing nnvm InferShape), with per-op PARAMETER
+        shape rules solving unknown weight/bias shapes from data shapes
+        (the FInferShape bidirectionality the layer ops need).
+
+        kwargs: name → shape tuple.  Returns (arg_shapes, out_shapes,
+        aux_shapes) in list_arguments order; unsolved args → None."""
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        shapes = self._infer_all(known)
+        args = self.list_arguments()
+        out = shapes.get(self.name)
+        if out is not None and not isinstance(out, list):
+            out = [out]
+        return ([known.get(a) for a in args],
+                [tuple(o) for o in out] if out is not None else None, [])
+
+    infer_shape_partial = infer_shape
+
+    def _infer_all(self, known):
+        """Walk topo order; solve unknown input-var shapes via
+        _PARAM_SHAPE_RULES; compute node output shapes abstractly."""
+        import jax
+        import jax.numpy as jnp
+
+        shapes = {}
+
+        def shape_of(sym):
+            s = shapes.get(sym.name)
+            if isinstance(s, list):
+                return s[sym.out_index]
+            return s
+
+        for node in self._topo():
+            if node.op is None:
+                if "__scalar__" in node.attrs:
+                    shapes[node.name] = ()
+                else:
+                    shapes[node.name] = known.get(node.name)
+                continue
+            in_shapes = [shape_of(i) for i in node.inputs]
+            if any(s is None for s in in_shapes):
+                rule = _PARAM_SHAPE_RULES.get(node.op)
+                if rule is not None:
+                    solved = rule(in_shapes, node.attrs)
+                    for i, s in zip(node.inputs, solved):
+                        if s is not None and shapes.get(i.name) is None:
+                            shapes[i.name] = tuple(s)
+                            known[i.name] = tuple(s)
+                    in_shapes = [shape_of(i) for i in node.inputs]
+            if any(s is None for s in in_shapes):
+                shapes[node.name] = None
+                continue
+            specs = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+                     for s in in_shapes]
+            opdef = _registry.get(node.op)
+            try:
+                out = jax.eval_shape(
+                    lambda *a, _f=opdef.fn, _kw=node.attrs: _f(*a, **_kw),
+                    *specs)
+            except Exception:
+                shapes[node.name] = None
+                continue
+            if isinstance(out, (tuple, list)):
+                shapes[node.name] = [tuple(o.shape) for o in out]
+            else:
+                shapes[node.name] = tuple(out.shape)
+        return shapes
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        import numpy as np
+
+        return ([np.float32] * len(args), [np.float32], [])
+
+    # -- serialization ---------------------------------------------------------
+
+    def tojson(self):
+        """Reference-layout graph JSON ({'nodes', 'arg_nodes', 'heads'},
+        Symbol.tojson)."""
+        order = self._topo()
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        arg_nodes = []
+        for i, n in enumerate(order):
+            if n.op is None:
+                arg_nodes.append(i)
+            nodes.append({
+                "op": "null" if n.op is None else n.op,
+                "name": n.name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in n.attrs.items()},
+                "inputs": [[index[id(s)], s.out_index, 0]
+                           for s in n.inputs],
+            })
+        heads = [[index[id(self)], self.out_index, 0]]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["str", "2.0-tpu"]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding (Executor) ----------------------------------------------------
+
+    def simple_bind(self, ctx=None, grad_req="write", **kwargs):
+        from .executor import Executor
+
+        arg_shapes, _, _ = self.infer_shape(**kwargs)
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import _from_jax
+
+        names = self.list_arguments()
+        for name, shape in zip(names, arg_shapes):
+            if shape is None:
+                raise MXNetError(
+                    f"simple_bind could not infer the shape of '{name}'; "
+                    "pass it explicitly (e.g. "
+                    f"simple_bind({name}=(...), ...))")
+        args = {name: _from_jax(jnp.zeros(shape, jnp.float32))
+                for name, shape in zip(names, arg_shapes)}
+        return Executor(self, args, grad_req=grad_req, ctx=ctx)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from .executor import Executor
+
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.list_arguments(), args))
+        return Executor(self, args, args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux_states, ctx=ctx)
+
+
+class Group(Symbol):
+    """Multiple outputs grouped (reference: mx.sym.Group)."""
+
+    def __init__(self, symbols):
+        name = _auto_name("group")
+        super().__init__("_group", name, list(symbols), {},
+                         n_outputs=len(symbols))
+
+    def eval_raw(self, **env):
+        return tuple(s.eval_raw(**env) for s in self.inputs)
+
+    def list_outputs(self):
+        return [o for s in self.inputs for o in s.list_outputs()]
+
+
+def _as_single(node):
+    return node
+
+
+# -- parameter shape rules (the FInferShape bidirectionality; reference:
+# per-op FInferShape in src/operator/**) --------------------------------------
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= x
+    return p
+
+
+def _fc_rule(in_shapes, attrs):
+    data = in_shapes[0]
+    nh = attrs.get("num_hidden")
+    if data is None or nh is None:
+        return [None] * len(in_shapes)
+    flatten = attrs.get("flatten", True)
+    in_units = _prod(data[1:]) if flatten else data[-1]
+    out = [data, (nh, in_units)]
+    if len(in_shapes) > 2:
+        out.append((nh,))
+    return out
+
+
+def _conv_rule(in_shapes, attrs):
+    data = in_shapes[0]
+    nf = attrs.get("num_filter")
+    kernel = attrs.get("kernel")
+    if data is None or nf is None or kernel is None:
+        return [None] * len(in_shapes)
+    groups = attrs.get("num_group", 1)
+    k = (kernel,) * (len(data) - 2) if isinstance(kernel, int) \
+        else tuple(kernel)
+    out = [data, (nf, data[1] // groups) + k]
+    if len(in_shapes) > 2:
+        out.append((nf,))
+    return out
+
+
+def _deconv_rule(in_shapes, attrs):
+    data = in_shapes[0]
+    nf = attrs.get("num_filter")
+    kernel = attrs.get("kernel")
+    if data is None or nf is None or kernel is None:
+        return [None] * len(in_shapes)
+    groups = attrs.get("num_group", 1)
+    k = (kernel,) * (len(data) - 2) if isinstance(kernel, int) \
+        else tuple(kernel)
+    out = [data, (data[1], nf // groups) + k]
+    if len(in_shapes) > 2:
+        out.append((nf,))
+    return out
+
+
+def _channel_rule(axis_default):
+    def rule(in_shapes, attrs):
+        data = in_shapes[0]
+        if data is None:
+            return [None] * len(in_shapes)
+        axis = attrs.get("axis", axis_default)
+        c = data[axis]
+        return [data] + [(c,)] * (len(in_shapes) - 1)
+    return rule
+
+
+def _embedding_rule(in_shapes, attrs):
+    din = attrs.get("input_dim")
+    dout = attrs.get("output_dim")
+    if din is None or dout is None:
+        return [None] * len(in_shapes)
+    return [in_shapes[0], (din, dout)]
+
+
+def _label_like_batch_rule(in_shapes, attrs):
+    data = in_shapes[0]
+    if data is None:
+        return [None] * len(in_shapes)
+    return [data, (data[0],)]
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "fully_connected": _fc_rule,
+    "Convolution": _conv_rule,
+    "convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _channel_rule(1),
+    "batch_norm": _channel_rule(1),
+    "LayerNorm": _channel_rule(-1),
+    "layer_norm": _channel_rule(-1),
+    "InstanceNorm": _channel_rule(1),
+    "GroupNorm": _channel_rule(1),
+    "Embedding": _embedding_rule,
+    "SoftmaxOutput": _label_like_batch_rule,
+    "softmax_output": _label_like_batch_rule,
+}
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+        dtype=None, init=None, stype=None, **kwargs):
+    """mx.sym.Variable (reference: symbol.var)."""
+    s = Symbol(None, name, [], {})
+    s._set_attr(shape=shape, lr_mult=lr_mult, wd_mult=wd_mult,
+                dtype=dtype, init=init, **(attr or {}))
+    return s
+
+
+Variable = var
+
+
+def _scalar_sym(value):
+    s = var(_auto_name("scalar"))
+    s._set_attr(__scalar__=float(value))
+    s.attrs["__scalar__"] = float(value)
+    return s
+
+
+def apply_op(opname, *sym_inputs, name=None, **kwargs):
+    """Create a graph node applying a registered op."""
+    _registry.get(opname)  # validate now
+    nm = name or _auto_name(opname.lower().replace("_", ""))
+    inputs = list(sym_inputs)
+    # multi-output ops: reflected lazily when indexing
+    return Symbol(opname, nm, inputs, kwargs)
+
+
+def load(fname):
+    """Load a symbol.json (reference: mx.sym.load)."""
+    with open(fname) as f:
+        data = json.load(f)
+    return fromjson(data)
+
+
+def fromjson(data):
+    if isinstance(data, str):
+        data = json.loads(data)
+    nodes = data["nodes"]
+    built = []
+    for nd in nodes:
+        attrs = {}
+        for k, v in nd.get("attrs", {}).items():
+            try:
+                attrs[k] = json.loads(v)
+            except (json.JSONDecodeError, TypeError):
+                attrs[k] = v
+        if nd["op"] == "null":
+            built.append(var(nd["name"]))
+        else:
+            inputs = [built[i][oi] for i, oi, _ in nd["inputs"]]
+            sym = apply_op(nd["op"], *inputs, name=nd["name"], **attrs)
+            built.append(sym)
+    head, oi, _ = data["heads"][0]
+    return built[head][oi] if oi else built[head]
+
+
+def trace_block(block):
+    """Build a Symbol graph from a hybridized gluon block by symbolic
+    tracing (the HybridBlock.export path)."""
+    raise NotImplementedError(
+        "symbolic export of arbitrary hybrid blocks lands with the jaxpr→"
+        "Symbol converter; use Block.save_parameters + SymbolBlock for "
+        "python-defined models, or build graphs with mx.sym directly")

@@ -1,0 +1,344 @@
+// Native RecordIO codec + threaded prefetcher.
+//
+// Reference parity: 3rdparty/dmlc-core RecordIO (include/dmlc/recordio.h,
+// src/io/recordio_split.cc) and the threaded data pipeline
+// (dmlc::ThreadedIter + src/io/iter_prefetcher.h) — the C++ side of the
+// reference's input path, rebuilt for the TPU framework.
+//
+// Byte-compatible framing with mxnet_tpu/recordio.py:
+//   [kMagic u32][cflag(3b)|len(29b) u32][payload][pad to 4]
+// cflag: 0 whole, 1 start, 2 middle, 3 end (records containing the magic
+// are split so no payload chunk embeds a full magic header).
+//
+// Exposed as a flat C API (ctypes-loadable; reference: the c_api layer
+// design, include/mxnet/c_api.h).  Build: `make -C src` → libmxtpu_io.so.
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Reader {
+  FILE* fp = nullptr;
+  std::string err;
+};
+
+struct Writer {
+  FILE* fp = nullptr;
+  std::vector<int64_t> idx;  // record start offsets
+  std::string err;
+};
+
+bool ReadRecordAt(FILE* fp, int64_t offset, std::string* out,
+                  std::string* err) {
+  if (offset >= 0 && std::fseek(fp, offset, SEEK_SET) != 0) {
+    *err = "seek failed";
+    return false;
+  }
+  out->clear();
+  while (true) {
+    uint32_t header[2];
+    size_t n = std::fread(header, 1, sizeof(header), fp);
+    if (n == 0 && out->empty()) return false;  // clean EOF
+    if (n != sizeof(header)) {
+      *err = "truncated record header";
+      return false;
+    }
+    if (header[0] != kMagic) {
+      *err = "bad magic";
+      return false;
+    }
+    uint32_t cflag = header[1] >> 29;
+    uint32_t len = header[1] & kLenMask;
+    size_t cur = out->size();
+    out->resize(cur + len);
+    if (len && std::fread(&(*out)[cur], 1, len, fp) != len) {
+      *err = "truncated payload";
+      return false;
+    }
+    uint32_t pad = (4 - len % 4) % 4;
+    if (pad) std::fseek(fp, pad, SEEK_CUR);
+    if (cflag == 0 || cflag == 3) return true;
+  }
+}
+
+void WriteChunk(FILE* fp, uint32_t cflag, const char* data, uint32_t len) {
+  uint32_t header[2] = {kMagic, (cflag << 29) | len};
+  std::fwrite(header, 1, sizeof(header), fp);
+  std::fwrite(data, 1, len, fp);
+  uint32_t pad = (4 - len % 4) % 4;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad) std::fwrite(zeros, 1, pad, fp);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------- reader ----------
+
+void* mxtpu_recio_open_read(const char* path) {
+  auto* r = new Reader();
+  r->fp = std::fopen(path, "rb");
+  if (!r->fp) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void mxtpu_recio_close_read(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (r->fp) std::fclose(r->fp);
+  delete r;
+}
+
+// Scan the whole file, returning record offsets.  Caller frees with
+// mxtpu_free_i64.  Returns count, or -1 on error.
+int64_t mxtpu_recio_scan(void* h, int64_t** offsets_out) {
+  auto* r = static_cast<Reader*>(h);
+  std::fseek(r->fp, 0, SEEK_SET);
+  std::vector<int64_t> offsets;
+  std::string buf;
+  while (true) {
+    int64_t pos = std::ftell(r->fp);
+    std::string err;
+    if (!ReadRecordAt(r->fp, -1, &buf, &err)) {
+      if (!err.empty()) return -1;
+      break;
+    }
+    offsets.push_back(pos);
+  }
+  auto* out = new int64_t[offsets.size()];
+  std::memcpy(out, offsets.data(), offsets.size() * sizeof(int64_t));
+  *offsets_out = out;
+  return static_cast<int64_t>(offsets.size());
+}
+
+// Read one record at byte offset; caller frees with mxtpu_free.  Returns
+// payload size or -1.
+int64_t mxtpu_recio_read_at(void* h, int64_t offset, char** data_out) {
+  auto* r = static_cast<Reader*>(h);
+  std::string buf, err;
+  if (!ReadRecordAt(r->fp, offset, &buf, &err)) return -1;
+  char* out = new char[buf.size()];
+  std::memcpy(out, buf.data(), buf.size());
+  *data_out = out;
+  return static_cast<int64_t>(buf.size());
+}
+
+void mxtpu_free(char* p) { delete[] p; }
+void mxtpu_free_i64(int64_t* p) { delete[] p; }
+
+// ---------- writer ----------
+
+void* mxtpu_recio_open_write(const char* path, int append) {
+  auto* w = new Writer();
+  w->fp = std::fopen(path, append ? "ab" : "wb");
+  if (!w->fp) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int64_t mxtpu_recio_write(void* h, const char* data, int64_t len) {
+  auto* w = static_cast<Writer*>(h);
+  int64_t pos = std::ftell(w->fp);
+  // split on embedded magics so no chunk payload contains the header
+  const char* magic_bytes = reinterpret_cast<const char*>(&kMagic);
+  std::vector<std::pair<const char*, uint32_t>> chunks;
+  const char* cur = data;
+  int64_t remaining = len;
+  while (true) {
+    const char* found = nullptr;
+    if (remaining >= 4) {
+      for (const char* p = cur; p + 4 <= cur + remaining; ++p) {
+        if (std::memcmp(p, magic_bytes, 4) == 0) {
+          found = p;
+          break;
+        }
+      }
+    }
+    if (!found) {
+      chunks.emplace_back(cur, static_cast<uint32_t>(remaining));
+      break;
+    }
+    uint32_t take = static_cast<uint32_t>(found - cur) + 2;  // split magic
+    chunks.emplace_back(cur, take);
+    cur += take;
+    remaining -= take;
+  }
+  if (chunks.size() == 1) {
+    WriteChunk(w->fp, 0, chunks[0].first, chunks[0].second);
+  } else {
+    for (size_t i = 0; i < chunks.size(); ++i) {
+      uint32_t cflag = i == 0 ? 1 : (i + 1 == chunks.size() ? 3 : 2);
+      WriteChunk(w->fp, cflag, chunks[i].first, chunks[i].second);
+    }
+  }
+  w->idx.push_back(pos);
+  return pos;
+}
+
+void mxtpu_recio_close_write(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  if (w->fp) std::fclose(w->fp);
+  delete w;
+}
+
+// ---------- threaded prefetcher ----------
+// The dmlc::ThreadedIter analog: N reader threads pull record indices from
+// an epoch queue, read payloads, and push them into a bounded buffer the
+// python side drains batch by batch.
+
+struct Prefetcher {
+  std::string path;
+  std::vector<int64_t> offsets;
+  std::vector<uint32_t> order;
+  size_t cursor = 0;            // next index to hand to workers
+  size_t delivered = 0;         // records handed to python this epoch
+  uint64_t epoch = 0;           // guards against stale worker pushes
+  bool shuffle = false;
+  uint64_t seed = 0;
+  size_t capacity = 256;
+  std::deque<std::pair<uint32_t, std::string>> buffer;  // (order-pos, rec)
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::vector<std::thread> workers;
+  bool stop = false;
+
+  void WorkerLoop() {
+    FILE* fp = std::fopen(path.c_str(), "rb");
+    if (!fp) return;
+    while (true) {
+      size_t my_pos;
+      uint64_t my_epoch;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_full.wait(lk, [&] {
+          return stop || (cursor < order.size() &&
+                          buffer.size() < capacity);
+        });
+        if (stop) break;
+        my_pos = cursor++;
+        my_epoch = epoch;
+      }
+      std::string rec, err;
+      int64_t off = offsets[order[my_pos]];
+      bool ok = ReadRecordAt(fp, off, &rec, &err);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        if (my_epoch == epoch) {  // drop stale reads from before a reset
+          buffer.emplace_back(static_cast<uint32_t>(my_pos),
+                              ok ? std::move(rec) : std::string());
+          cv_empty.notify_all();
+        }
+      }
+    }
+    std::fclose(fp);
+  }
+};
+
+void* mxtpu_prefetcher_create(const char* path, int n_threads, int shuffle,
+                              uint64_t seed) {
+  auto* p = new Prefetcher();
+  p->path = path;
+  p->shuffle = shuffle != 0;
+  p->seed = seed;
+  void* rh = mxtpu_recio_open_read(path);
+  if (!rh) {
+    delete p;
+    return nullptr;
+  }
+  int64_t* offs = nullptr;
+  int64_t n = mxtpu_recio_scan(rh, &offs);
+  mxtpu_recio_close_read(rh);
+  if (n < 0) {
+    delete p;
+    return nullptr;
+  }
+  p->offsets.assign(offs, offs + n);
+  mxtpu_free_i64(offs);
+  p->order.resize(n);
+  for (int64_t i = 0; i < n; ++i) p->order[i] = static_cast<uint32_t>(i);
+  if (p->shuffle) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  int nt = n_threads > 0 ? n_threads : 4;
+  for (int i = 0; i < nt; ++i)
+    p->workers.emplace_back(&Prefetcher::WorkerLoop, p);
+  return p;
+}
+
+int64_t mxtpu_prefetcher_size(void* h) {
+  return static_cast<Prefetcher*>(h)->offsets.size();
+}
+
+// Pop the next record (in epoch order); returns size, -1 at epoch end.
+// Caller frees data with mxtpu_free.
+int64_t mxtpu_prefetcher_next(void* h, char** data_out) {
+  auto* p = static_cast<Prefetcher*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->delivered >= p->order.size()) return -1;
+  uint32_t want = static_cast<uint32_t>(p->delivered);
+  p->cv_empty.wait(lk, [&] {
+    for (auto& kv : p->buffer)
+      if (kv.first == want) return true;
+    return false;
+  });
+  for (auto it = p->buffer.begin(); it != p->buffer.end(); ++it) {
+    if (it->first == want) {
+      int64_t size = static_cast<int64_t>(it->second.size());
+      char* out = new char[size];
+      std::memcpy(out, it->second.data(), size);
+      *data_out = out;
+      p->buffer.erase(it);
+      p->delivered++;
+      p->cv_full.notify_all();
+      return size;
+    }
+  }
+  return -1;  // unreachable
+}
+
+// Start a new epoch (reshuffles when shuffle is on).
+void mxtpu_prefetcher_reset(void* h, uint64_t seed) {
+  auto* p = static_cast<Prefetcher*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->buffer.clear();
+  p->cursor = 0;
+  p->delivered = 0;
+  p->epoch++;
+  if (p->shuffle) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(p->order.begin(), p->order.end(), rng);
+  }
+  p->cv_full.notify_all();
+}
+
+void mxtpu_prefetcher_destroy(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_full.notify_all();
+  }
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+}  // extern "C"

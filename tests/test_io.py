@@ -158,6 +158,65 @@ def test_image_record_iter(tmp_path):
     assert batch.label[0].shape == (4,)
 
 
+def test_native_recordio_interop(tmp_path):
+    """C++ codec (src/recordio.cc) ↔ python codec byte compatibility."""
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library not built (make -C src)")
+    import struct
+
+    path = str(tmp_path / "n.rec")
+    payloads = [b"hello", b"x" * 999, b"",
+                b"abc" + struct.pack("<I", 0xced7230a) + b"def"]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = _native.NativeRecordReader(path)
+    offsets = r.scan()
+    assert len(offsets) == len(payloads)
+    for off, exp in zip(offsets, payloads):
+        assert r.read_at(off) == exp
+    r.close()
+
+    path2 = str(tmp_path / "n2.rec")
+    w2 = _native.NativeRecordWriter(path2)
+    for p in payloads:
+        w2.write(p)
+    w2.close()
+    rd = recordio.MXRecordIO(path2, "r")
+    for exp in payloads:
+        assert rd.read() == exp
+    assert rd.read() is None
+
+
+def test_native_prefetcher(tmp_path):
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library not built (make -C src)")
+    path = str(tmp_path / "p.rec")
+    payloads = [f"record{i}".encode() for i in range(20)]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    pf = _native.NativePrefetcher(path, n_threads=3)
+    assert len(pf) == 20
+    got = []
+    while True:
+        rec = pf.next()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+    pf.reset(seed=1)
+    got2 = [pf.next() for _ in range(20)]
+    assert got2 == payloads
+    pf.close()
+
+
 def test_gluon_dataset_and_dataloader():
     data = np.random.rand(20, 5).astype(np.float32)
     labels = np.arange(20).astype(np.float32)

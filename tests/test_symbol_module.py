@@ -1,0 +1,134 @@
+"""Symbol + Module legacy API tests (reference:
+tests/python/unittest/test_symbol.py, test_module.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    w1 = mx.sym.Variable("fc1_weight")
+    b1 = mx.sym.Variable("fc1_bias")
+    h = mx.sym.FullyConnected(data, w1, b1, num_hidden=16,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    w2 = mx.sym.Variable("fc2_weight")
+    b2 = mx.sym.Variable("fc2_bias")
+    out = mx.sym.FullyConnected(h, w2, b2, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(out, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    net = _mlp_symbol()
+    args = net.list_arguments()
+    assert args[0] == "data"
+    assert "fc1_weight" in args and "softmax_label" in args
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2 * a + b / a
+    out = c.eval(a=mx.nd.array([2.0]), b=mx.nd.array([6.0]))
+    np.testing.assert_allclose(out.asnumpy(), [7.0])
+
+
+def test_symbol_infer_shape():
+    net = _mlp_symbol()
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(8, 10), fc1_weight=(16, 10), fc1_bias=(16,),
+        fc2_weight=(4, 16), fc2_bias=(4,), softmax_label=(8,))
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    net = _mlp_symbol()
+    f = str(tmp_path / "net-symbol.json")
+    net.save(f)
+    net2 = mx.sym.load(f)
+    assert net2.list_arguments() == net.list_arguments()
+    # eval equivalence
+    rng = np.random.RandomState(0)
+    env = {"data": mx.nd.array(rng.randn(2, 10).astype(np.float32)),
+           "fc1_weight": mx.nd.array(rng.randn(16, 10).astype(np.float32)),
+           "fc1_bias": mx.nd.zeros((16,)),
+           "fc2_weight": mx.nd.array(rng.randn(4, 16).astype(np.float32)),
+           "fc2_bias": mx.nd.zeros((4,)),
+           "softmax_label": mx.nd.zeros((2,))}
+    np.testing.assert_allclose(net.eval(**env).asnumpy(),
+                               net2.eval(**env).asnumpy(), rtol=1e-5)
+
+
+def test_executor_forward_backward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.broadcast_mul(a, b)
+    exe = c.simple_bind(a=(3,), b=(3,))
+    exe.arg_dict["a"]._set_data(mx.nd.array([1.0, 2.0, 3.0])._data)
+    exe.arg_dict["b"]._set_data(mx.nd.array([4.0, 5.0, 6.0])._data)
+    out = exe.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 10.0, 18.0])
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["a"].asnumpy(),
+                               [4.0, 5.0, 6.0])
+    np.testing.assert_allclose(exe.grad_dict["b"].asnumpy(),
+                               [1.0, 2.0, 3.0])
+
+
+def test_module_fit_mnist_style():
+    """Tiny Module.fit run (reference: tests/python/train/test_mlp.py via
+    Module)."""
+    rng = np.random.RandomState(0)
+    centers = rng.uniform(-2, 2, size=(4, 10)).astype(np.float32)
+    labels = rng.randint(0, 4, 256)
+    data = centers[labels] + rng.normal(0, 0.4, (256, 10)) \
+        .astype(np.float32)
+    train_iter = mx.io.NDArrayIter(data, labels.astype(np.float32),
+                                   batch_size=32, shuffle=True,
+                                   label_name="softmax_label")
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train_iter, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+    score = mod.score(train_iter, "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 10).astype(np.float32)
+    it = mx.io.NDArrayIter(data, np.zeros(16, np.float32), batch_size=8,
+                           label_name="softmax_label")
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    preds = mod.predict(it)
+    assert preds.shape == (16, 4)
+
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    assert "fc1_weight" in arg2
+    mod2 = mx.mod.Module(net)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=arg2, aux_params=aux2)
+    preds2 = mod2.predict(it)
+    np.testing.assert_allclose(preds.asnumpy(), preds2.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_symbol_grouping():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.relu(a)
+    s2 = mx.sym.sigmoid(a)
+    g = mx.sym.Group([s1, s2])
+    outs = g.eval_raw(a=np.array([-1.0, 1.0], np.float32))
+    assert len(outs) == 2

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""All-reduce bandwidth measurement.
+
+Reference parity: tools/bandwidth/measure.py (the 'KVStore all-reduce BW'
+BASELINE metric) — measures achieved all-reduce GB/s over the device mesh
+(ICI on real TPU; the virtual CPU mesh for dry runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size-mb", type=float, default=64.0,
+                        help="tensor size per all-reduce")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="0 = all visible devices")
+    args = parser.parse_args()
+
+    import jax
+
+    from mxnet_tpu import parallel
+
+    n = args.devices or len(jax.devices())
+    mesh = parallel.make_mesh(dp=n)
+    bw = parallel.collectives.measure_allreduce_bandwidth(
+        mesh, size_mb=args.size_mb, dtype=args.dtype, iters=args.iters)
+    print(json.dumps({
+        "metric": "allreduce_bandwidth",
+        "value": round(bw, 3),
+        "unit": "GB/s",
+        "devices": n,
+        "size_mb": args.size_mb,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""im2rec: pack image datasets into RecordIO (.rec + .idx).
+
+Reference parity: tools/im2rec.py — builds .lst files from image folders
+and encodes them into the RecordIO container the data pipeline consumes.
+PIL does codec work (the reference uses OpenCV).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_image(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                print(f"lst should have at least has three parts, but only "
+                      f"has {line_len} parts for {line}")
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print(f"Parsing lst met error for {line}, detail: {e}")
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    import numpy as np
+
+    from mxnet_tpu import image as img_mod
+    from mxnet_tpu import recordio
+
+    fullpath = os.path.join(args.root, item[1])
+    header = recordio.IRHeader(0, item[2] if len(item) == 3 else item[2:],
+                               item[0], 0)
+    try:
+        with open(fullpath, "rb") as fin:
+            img_bytes = fin.read()
+        if args.pass_through:
+            s = recordio.pack(header, img_bytes)
+            q_out.append((i, s, item))
+            return
+        arr = img_mod.imdecode_np(img_bytes)
+        if args.center_crop and arr.shape[0] != arr.shape[1]:
+            size = min(arr.shape[:2])
+            arr = img_mod.center_crop_np(arr, (size, size))
+        if args.resize and (arr.shape[0] > args.resize
+                            or arr.shape[1] > args.resize):
+            arr = img_mod.resize_short_np(arr, args.resize)
+        s = recordio.pack_img(header, arr, quality=args.quality,
+                              img_fmt=args.encoding)
+        q_out.append((i, s, item))
+    except Exception as e:
+        print(f"imread error trying to load file: {fullpath}: {e}")
+        q_out.append((i, None, item))
+
+
+def make_rec(args, image_list):
+    from mxnet_tpu import recordio
+
+    fname = os.path.basename(args.prefix)
+    working_dir = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    record = recordio.MXIndexedRecordIO(
+        os.path.join(working_dir, fname + ".idx"),
+        os.path.join(working_dir, fname + ".rec"), "w")
+    count = 0
+    for i, item in enumerate(image_list):
+        out = []
+        image_encode(args, i, item, out)
+        _, s, it = out[0]
+        if s is None:
+            continue
+        record.write_idx(it[0], s)
+        count += 1
+        if count % 1000 == 0:
+            print(f"{count} images packed")
+    record.close()
+    print(f"total {count} images packed into {args.prefix}.rec")
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO file")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="make a .lst file instead of a .rec")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--recursive", action="store_true")
+    parser.add_argument("--shuffle", action="store_true", default=True)
+    parser.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0.0)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="skip decode/encode, pack raw bytes")
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", choices=[".jpg", ".png"],
+                        default=".jpg")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        image_list = list(list_image(args.root, args.recursive,
+                                     args.exts))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(image_list)
+        n = len(image_list)
+        n_train = int(n * args.train_ratio)
+        n_test = int(n * args.test_ratio)
+        if args.train_ratio < 1.0:
+            write_list(args.prefix + "_train.lst", image_list[:n_train])
+            if n_test:
+                write_list(args.prefix + "_test.lst",
+                           image_list[n_train:n_train + n_test])
+            write_list(args.prefix + "_val.lst",
+                       image_list[n_train + n_test:])
+        else:
+            write_list(args.prefix + ".lst", image_list)
+    else:
+        lst = args.prefix + ".lst" if not args.prefix.endswith(".lst") \
+            else args.prefix
+        if os.path.exists(lst):
+            image_list = list(read_list(lst))
+        else:
+            image_list = [(i, p, l) for i, p, l in
+                          list_image(args.root, args.recursive, args.exts)]
+            if args.shuffle:
+                random.seed(100)
+                random.shuffle(image_list)
+        make_rec(args, image_list)
+
+
+if __name__ == "__main__":
+    main()

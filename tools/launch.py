@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Reference parity: tools/launch.py + dmlc-core tracker — spawns the
+scheduler/server/worker processes for dist kvstore.
+
+TPU-native redesign (SURVEY.md §2.6): there is no parameter server; a
+"distributed job" is N identical processes joining one
+``jax.distributed.initialize`` rendezvous (coordinator address replaces the
+dmlc tracker).  Supported launchers: ``local`` (N processes on this host —
+the analog of the reference's fake-multi-node nightly tests) and ``ssh``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(args, cmd):
+    """Spawn n worker processes on localhost, each with the env
+    jax.distributed expects (reference: dmlc tracker 'local' mode env
+    DMLC_ROLE/DMLC_PS_ROOT_URI → MXTPU_COORDINATOR/RANK/WORLD)."""
+    procs = []
+    coord = f"127.0.0.1:{args.port}"
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": coord,
+            "MXTPU_NUM_WORKERS": str(args.num_workers),
+            "MXTPU_WORKER_RANK": str(rank),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def launch_ssh(args, cmd):
+    assert args.hostfile, "--hostfile required for ssh launcher"
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    assert len(hosts) >= args.num_workers
+    coord = f"{hosts[0]}:{args.port}"
+    procs = []
+    for rank in range(args.num_workers):
+        envs = (f"MXTPU_COORDINATOR={coord} "
+                f"MXTPU_NUM_WORKERS={args.num_workers} "
+                f"MXTPU_WORKER_RANK={rank}")
+        remote = f"cd {os.getcwd()} && {envs} {' '.join(cmd)}"
+        procs.append(subprocess.Popen(["ssh", hosts[rank], remote]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("--hostfile", default=None)
+    parser.add_argument("--port", type=int, default=9927)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args, cmd))
+    sys.exit(launch_ssh(args, cmd))
+
+
+if __name__ == "__main__":
+    main()
