@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""BERT pretraining over a dp×tp mesh with LAMB (the BASELINE
+'BERT-base + hybridize→XLA + LAMB' config; reference model lives in
+GluonNLP — here it's native, gluon/model_zoo/bert.py).
+
+Long sequences: pass --attention ring and a mesh with an sp axis to run
+ring attention (sequence parallelism) inside the same compiled step.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon.model_zoo import bert
+
+
+def synthetic_batch(rng, batch, seq_len, vocab):
+    ids = rng.randint(0, vocab, (batch, seq_len)).astype(np.int32)
+    mlm = np.where(rng.rand(batch, seq_len) < 0.15, ids, -1) \
+        .astype(np.float32)
+    nsp = rng.randint(0, 2, (batch,)).astype(np.float32)
+    return ids, (mx.nd.array(mlm), mx.nd.array(nsp))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="bert_base",
+                        choices=["bert_tiny", "bert_base", "bert_large"])
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--dp", type=int, default=0, help="0 = auto")
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--attention", default="dense",
+                        choices=["dense", "flash", "ring", "ulysses"])
+    parser.add_argument("--dtype", default="bfloat16")
+    args = parser.parse_args()
+
+    import jax
+
+    n = len(jax.devices())
+    dp = args.dp or max(1, n // (args.tp * args.sp))
+    mesh = parallel.make_mesh(dp=dp, tp=args.tp, sp=args.sp)
+    parallel.set_default_mesh(mesh)
+    print(f"mesh: dp={dp} tp={args.tp} sp={args.sp}")
+
+    builder = getattr(bert, args.model)
+    net = builder(max_length=args.seq_len,
+                  attention_impl=args.attention)
+    net.initialize(init=mx.init.Xavier())
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    vocab = net.word_embed_weight.shape[0]
+
+    trainer = parallel.ShardedTrainer(
+        net, bert.BERTPretrainLoss(), "lamb",
+        {"learning_rate": args.lr,
+         "lr_scheduler": mx.lr_scheduler.PolyScheduler(
+             max_update=args.steps, base_lr=args.lr, warmup_steps=5)},
+        mesh=mesh, rules=parallel.TRANSFORMER_TP_RULES)
+
+    rng = np.random.RandomState(0)
+    ids, labels = synthetic_batch(rng, args.batch_size, args.seq_len,
+                                  vocab)
+    trainer.step(ids, labels).wait_to_read()  # compile
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        loss = trainer.step(ids, labels)
+        if step % 10 == 0:
+            print(f"step {step} loss {float(loss.asscalar()):.4f}")
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    toks = args.batch_size * args.seq_len * args.steps / dt
+    print(f"throughput: {toks:.0f} tokens/sec "
+          f"({toks / n:.0f} tokens/sec/chip)")
+
+
+if __name__ == "__main__":
+    main()

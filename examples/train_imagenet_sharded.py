@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""ResNet-50 data-parallel training over the device mesh (reference:
+example/image-classification/train_imagenet.py — the BASELINE ResNet-50
+config; kvstore='device' replaced by the compiled mesh step).
+
+Reads ImageNet-style .rec files when given; otherwise synthetic batches.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rec", default=None, help=".rec training file")
+    parser.add_argument("--batch-size", type=int, default=256,
+                        help="GLOBAL batch size over the mesh")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--network", default="resnet50_v1")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+
+    import jax
+
+    n = len(jax.devices())
+    mesh = parallel.data_parallel_mesh(n)
+    print(f"devices: {n}, mesh: {mesh}")
+
+    net = vision.get_model(args.network, classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4,
+         "lr_scheduler": mx.lr_scheduler.CosineScheduler(
+             max_update=args.steps, base_lr=args.lr, warmup_steps=5)},
+        mesh=mesh)
+
+    if args.rec:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.rec, batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size),
+            shuffle=True, rand_mirror=True, rand_crop=True)
+
+        def batches():
+            while True:
+                it.reset()
+                for b in it:
+                    yield b.data[0], b.label[0]
+    else:
+        print("no --rec given; synthetic data")
+        rng = np.random.RandomState(0)
+        import jax.numpy as jnp
+
+        x = jnp.asarray(rng.standard_normal(
+            (args.batch_size, 3, args.image_size, args.image_size)),
+            dtype=args.dtype)
+        y = jnp.asarray(rng.randint(0, 1000, args.batch_size)
+                        .astype(np.float32))
+
+        def batches():
+            while True:
+                yield x, y
+
+    gen = batches()
+    t0 = None
+    for step in range(args.steps):
+        x, y = next(gen)
+        loss = trainer.step(x, y)
+        if step == 1:
+            loss.wait_to_read()
+            t0 = time.perf_counter()
+        if step % 20 == 0:
+            print(f"step {step} loss {float(loss.asscalar()):.4f} "
+                  f"lr {trainer.learning_rate:.4f}")
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    sps = args.batch_size * (args.steps - 2) / dt
+    print(f"throughput: {sps:.1f} samples/sec "
+          f"({sps / n:.1f} samples/sec/chip)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""MNIST training with the Gluon API (reference:
+example/gluon/mnist/mnist.py — the BASELINE 'MLP on MNIST' config).
+
+Uses real MNIST idx files when present under --data-dir; otherwise a
+synthetic stand-in so the example always runs.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.callback import BatchEndParam, Speedometer
+from mxnet_tpu.gluon import nn
+
+
+def get_data(data_dir, batch_size):
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img) or os.path.exists(img + ".gz"):
+        train = mx.io.MNISTIter(
+            image=img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=batch_size, flat=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=batch_size, flat=True, shuffle=False)
+        return train, val
+    print("MNIST files not found; using synthetic data")
+    rng = np.random.RandomState(0)
+    centers = rng.uniform(-1, 1, (10, 784)).astype(np.float32)
+    y = rng.randint(0, 10, 4096)
+    x = centers[y] + rng.normal(0, 0.3, (4096, 784)).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[:512], y[:512].astype(np.float32),
+                            batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", default="data/mnist")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--hybridize", action="store_true", default=True)
+    args = parser.parse_args()
+
+    train_iter, val_iter = get_data(args.data_dir, args.batch_size)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(256, activation="relu"),
+                nn.Dense(128, activation="relu"),
+                nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    speedometer = Speedometer(args.batch_size, frequent=50)
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        train_metric = mx.metric.Accuracy()
+        for nbatch, batch in enumerate(train_iter):
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            train_metric.update([y], [out])
+            speedometer(BatchEndParam(epoch, nbatch, train_metric))
+        val_iter.reset()
+        val_metric = mx.metric.Accuracy()
+        for batch in val_iter:
+            val_metric.update([batch.label[0]], [net(batch.data[0])])
+        print(f"epoch {epoch}: train-acc "
+              f"{train_metric.get()[1]:.4f}  val-acc "
+              f"{val_metric.get()[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,7 +46,8 @@ import importlib as _importlib
 for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "gluon", "io", "recordio", "image", "profiler", "runtime",
              "parallel", "test_utils", "util", "visualization", "operator",
-             "symbol", "model", "module", "lr_scheduler", "distributed"):
+             "symbol", "model", "module", "lr_scheduler", "distributed",
+             "amp", "checkpoint", "contrib"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

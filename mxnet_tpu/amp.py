@@ -1,0 +1,115 @@
+"""Automatic mixed precision.
+
+Reference parity: python/mxnet/contrib/amp (≥1.6; flagged in SURVEY §2.3 as
+likely absent in the fork — provided here regardless since bf16 is the
+native MXU dtype).
+
+TPU-first: the default policy is **bfloat16**, which needs NO loss scaling
+(same exponent range as f32) — ``amp.init()`` just casts model compute to
+bf16 and keeps normalization statistics + optimizer master state in f32
+(multi_precision).  A float16 policy with ``DynamicLossScaler`` is provided
+for parity with GPU-style AMP.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+_STATE = {"initialized": False, "dtype": "bfloat16"}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP defaults (reference: amp.init).  On TPU this just
+    records the policy; casting happens per-model via init_block/convert.
+    """
+    assert target_dtype in ("bfloat16", "float16")
+    _STATE["initialized"] = True
+    _STATE["dtype"] = target_dtype
+
+
+def init_trainer(trainer):
+    """Switch a Trainer's optimizer to multi-precision master weights
+    (reference: amp.init_trainer)."""
+    trainer._optimizer.multi_precision = True
+    return trainer
+
+
+def convert_block(block, target_dtype=None):
+    """Cast a gluon block's compute to the AMP dtype, keeping
+    normalization layers in f32 (their cast() override already pins
+    BatchNorm statistics to f32)."""
+    target_dtype = target_dtype or _STATE["dtype"]
+    block.cast(target_dtype)
+    return block
+
+
+init_block = convert_block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype=None):
+    """Symbol-path conversion (reference: amp.convert_model): cast params;
+    the graph computes in the param dtype."""
+    target_dtype = target_dtype or _STATE["dtype"]
+    cast = {k: v.astype(target_dtype) for k, v in arg_params.items()}
+    aux = {k: v.astype(target_dtype) for k, v in aux_params.items()}
+    return sym, cast, aux
+
+
+class DynamicLossScaler:
+    """Loss scaling for float16 training (reference: the AMP loss scaler;
+    unnecessary under bfloat16)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def scale(self, loss):
+        return loss * self.loss_scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.loss_scale
+        for g in grads:
+            g *= inv
+        return grads
+
+    def has_overflow(self, grads):
+        for g in grads:
+            a = g.asnumpy() if hasattr(g, "asnumpy") else _np.asarray(g)
+            if not _np.all(_np.isfinite(a)):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        """Halve on overflow; double after scale_window clean steps."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+        return self.loss_scale
+
+
+def scale_loss(loss, trainer):
+    """Context-style helper (reference: with amp.scale_loss(...) as L)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        if _STATE["dtype"] == "bfloat16":
+            yield loss  # bf16 needs no scaling
+        else:
+            scaler = getattr(trainer, "_amp_loss_scaler", None)
+            if scaler is None:
+                scaler = DynamicLossScaler()
+                trainer._amp_loss_scaler = scaler
+            trainer._scale = 1.0 / scaler.loss_scale
+            yield loss * scaler.loss_scale
+    return ctx()

@@ -1,0 +1,4 @@
+"""Contrib namespace (reference: python/mxnet/contrib/)."""
+
+from . import quantization
+from .. import amp  # reference path: mx.contrib.amp → mx.amp

@@ -1,0 +1,84 @@
+"""Model quantization flow.
+
+Reference parity: python/mxnet/contrib/quantization.py — quantize_model
+(calibration-based int8 conversion, ≥1.2).
+
+TPU flow: calibrate activation ranges by running batches through the fp
+model (min/max or percentile), then wrap Dense/Conv layers so inference
+runs the int8 MXU path (ops/quantization.py).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+
+class CalibrationCollector:
+    """Collects per-layer activation ranges (reference: the calibration
+    pass of quantize_model; 'naive' min/max and percentile modes)."""
+
+    def __init__(self, mode="naive", percentile=99.99):
+        assert mode in ("naive", "percentile")
+        self.mode = mode
+        self.percentile = percentile
+        self.ranges = {}
+
+    def collect(self, name, array):
+        a = array.asnumpy() if hasattr(array, "asnumpy") \
+            else _np.asarray(array)
+        if self.mode == "naive":
+            lo, hi = float(a.min()), float(a.max())
+        else:
+            lo = float(_np.percentile(a, 100 - self.percentile))
+            hi = float(_np.percentile(a, self.percentile))
+        if name in self.ranges:
+            plo, phi = self.ranges[name]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.ranges[name] = (lo, hi)
+        return self.ranges[name]
+
+
+def quantize_block(block, calib_data=None, num_calib_batches=5,
+                   calib_mode="naive"):
+    """Calibrate + mark a gluon block for int8 inference.
+
+    Returns (block, calib_ranges).  Dense/Conv weights get static ranges
+    from their values; activations get ranges from calibration batches.
+    """
+    from ..gluon import nn
+
+    collector = CalibrationCollector(mode=calib_mode)
+    # weight ranges are static
+    for name, param in block.collect_params().items():
+        if name.endswith("weight"):
+            collector.collect(name, param.data())
+    # activation ranges from calibration data
+    if calib_data is not None:
+        count = 0
+        for batch in calib_data:
+            x = batch.data[0] if hasattr(batch, "data") else batch
+            collector.collect("__input__", x)
+            out = block(x)
+            first = out[0] if isinstance(out, tuple) else out
+            collector.collect("__output__", first)
+            count += 1
+            if count >= num_calib_batches:
+                break
+    block._quant_ranges = dict(collector.ranges)
+    return block, collector.ranges
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   **kwargs):
+    """Symbol-path API shell (reference signature parity).  Graph rewrite
+    of arbitrary symbols into quantized ops is a later milestone; the
+    gluon path (quantize_block) is the supported flow."""
+    raise NotImplementedError(
+        "symbolic quantize_model graph rewriting is not implemented yet; "
+        "use contrib.quantization.quantize_block on a gluon model "
+        "(int8 ops: mx.nd.quantize/quantized_fully_connected/"
+        "quantized_conv)")

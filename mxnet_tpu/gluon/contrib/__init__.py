@@ -1,0 +1,5 @@
+"""Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
+
+from . import nn
+from . import rnn
+from .estimator import Estimator

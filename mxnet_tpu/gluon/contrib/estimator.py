@@ -1,0 +1,64 @@
+"""Estimator: the high-level gluon fit API (reference:
+python/mxnet/gluon/contrib/estimator — train/val loop with event
+handlers)."""
+
+from __future__ import annotations
+
+import time
+
+from ... import autograd, metric as metric_mod
+
+
+class Estimator:
+    def __init__(self, net, loss, metrics=None, trainer=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = [metric_mod.create(m)
+                              for m in (metrics or ["acc"])]
+        self.trainer = trainer
+
+    def evaluate(self, val_data, metrics=None):
+        metrics = [metric_mod.create(m) for m in metrics] \
+            if metrics else self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            x, y = self._split(batch)
+            out = self.net(x)
+            for m in metrics:
+                m.update([y], [out])
+        return [m.get() for m in metrics]
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    def fit(self, train_data, val_data=None, epochs=1,
+            batch_end_callback=None, epoch_end_callback=None):
+        for epoch in range(epochs):
+            tic = time.time()
+            for m in self.train_metrics:
+                m.reset()
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            nbatch = 0
+            for batch in train_data:
+                x, y = self._split(batch)
+                with autograd.record():
+                    out = self.net(x)
+                    loss = self.loss(out, y)
+                loss.backward()
+                self.trainer.step(x.shape[0])
+                for m in self.train_metrics:
+                    m.update([y], [out])
+                nbatch += 1
+                if batch_end_callback:
+                    batch_end_callback(epoch, nbatch, self.train_metrics)
+            if epoch_end_callback:
+                epoch_end_callback(epoch, self.train_metrics,
+                                   time.time() - tic)
+            if val_data is not None:
+                self.evaluate(val_data)
+        return self

@@ -1,0 +1,116 @@
+"""Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py
+— Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle)."""
+
+from __future__ import annotations
+
+from .. import nn
+from ..block import Block, HybridBlock
+from ..model_zoo.vision.squeezenet import HybridConcurrent  # canonical impl
+
+
+class Concurrent(Block):
+    """Parallel branches concatenated (reference: contrib.nn.Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(nn.Embedding):
+    """Reference: contrib.nn.SparseEmbedding (row_sparse grads).  Sparse
+    gradients densify on TPU (XLA scatter-add is already the grad of
+    gather), so this is the dense Embedding with the contrib name."""
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Reference: contrib.nn.SyncBatchNorm (cross-GPU BN).
+
+    Under ShardedTrainer the batch statistics are computed on the GLOBAL
+    batch automatically — jnp.mean over a dp-sharded array IS the
+    synchronized reduction (GSPMD inserts the psum) — so this is BatchNorm
+    with the contrib name; num_devices is accepted and ignored.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, C·f, W) → (N, C, W·f) (reference: contrib.nn.PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        N, Cf, W = x.shape
+        x = x.reshape((N, Cf // f, f, W))
+        x = F.transpose(x, axes=(0, 1, 3, 2))
+        return x.reshape((N, Cf // f, W * f))
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C·f², H, W) → (N, C, H·f, W·f) (reference: PixelShuffle2D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor, factor)
+        self._factors = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        N, C, H, W = x.shape
+        c = C // (f1 * f2)
+        x = x.reshape((N, c, f1, f2, H, W))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return x.reshape((N, c, H * f1, W * f2))
+
+
+class PixelShuffle3D(HybridBlock):
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor, factor, factor)
+        self._factors = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        N, C, D, H, W = x.shape
+        c = C // (f1 * f2 * f3)
+        x = x.reshape((N, c, f1, f2, f3, D, H, W))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return x.reshape((N, c, D * f1, H * f2, W * f3))

@@ -2,6 +2,8 @@
 
 from . import vision
 from . import bert
+from . import ssd
+from .ssd import SSD, SSDTrainLoss, ssd_detect
 from .bert import (BERTModel, BERTPretrainLoss, TransformerEncoder,
                    TransformerEncoderLayer, bert_base, bert_large,
                    bert_tiny)

@@ -176,51 +176,42 @@ class BERTModel(HybridBlock):
         return tuple(outputs) if len(outputs) > 1 else outputs[0]
 
 
+def _bert_pretrain_loss_pure(nsp_logits, mlm_logits, mlm_labels,
+                             nsp_labels):
+    import jax
+    import jax.numpy as jnp
+
+    valid = (mlm_labels >= 0)
+    safe_labels = jnp.maximum(mlm_labels, 0).astype(jnp.int32)
+    logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, safe_labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    mlm_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+    nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
+    nsp_nll = -jnp.take_along_axis(
+        nsp_logp, nsp_labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return mlm_loss + jnp.mean(nsp_nll)
+
+
 class BERTPretrainLoss(HybridBlock):
-    """MLM + NSP loss over BERTModel outputs (masked-position MLM)."""
+    """MLM + NSP loss over BERTModel outputs (masked-position MLM).
+
+    outputs: (seq, pooled, nsp_logits, mlm_logits); labels: (mlm_labels
+    (B,T) with -1 for unmasked positions, nsp_labels (B,)).  Routed
+    through the invoke layer: one tape node eagerly, pure under jit."""
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
-        from .. import loss as loss_mod
-
-        self._ce = loss_mod.SoftmaxCrossEntropyLoss()
 
     def hybrid_forward(self, F, outputs, labels):
-        # outputs: (seq, pooled, nsp_logits, mlm_logits)
-        # labels: dict-free tuple (mlm_labels (B,T) with -1 for unmasked,
-        #         nsp_labels (B,))
-        import jax.numpy as jnp
+        from ...ndarray.register import invoke_simple
 
         seq, pooled, nsp_logits, mlm_logits = outputs
         mlm_labels, nsp_labels = labels
-        raw = mlm_labels._data if hasattr(mlm_labels, "_data") \
-            else mlm_labels
-        mlm_raw = mlm_logits._data if hasattr(mlm_logits, "_data") \
-            else mlm_logits
-        valid = (raw >= 0)
-        safe_labels = jnp.maximum(raw, 0).astype(jnp.int32)
-        logp = _log_softmax(mlm_raw)
-        nll = -jnp.take_along_axis(
-            logp, safe_labels[..., None], axis=-1)[..., 0]
-        denom = jnp.maximum(jnp.sum(valid), 1)
-        mlm_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / denom
-        nsp_loss = self._ce(nsp_logits, nsp_labels)
-        nsp_raw = nsp_loss._data if hasattr(nsp_loss, "_data") else nsp_loss
-        total = mlm_loss + jnp.mean(nsp_raw)
-        from ...ndarray.ndarray import NDArray, _from_jax
-
-        if isinstance(seq, NDArray):
-            return _from_jax(total)
-        return total
-
-
-def _log_softmax(x):
-    import jax
-
-    return jax.nn.log_softmax(x, axis=-1)
-
-
-import jax  # noqa: E402  (used inside hybrid paths)
+        return invoke_simple(_bert_pretrain_loss_pure,
+                             (nsp_logits, mlm_logits, mlm_labels,
+                              nsp_labels))
 
 
 def bert_base(**kwargs):

@@ -1,0 +1,163 @@
+"""SSD: Single Shot MultiBox Detector.
+
+Reference parity: example/ssd/ (symbol/symbol_builder.py over the MultiBox
+ops) — the BASELINE 'SSD/Mask-RCNN dynamic-shape' config.  Model: VGG-ish /
+resnet features + multi-scale heads; anchors/targets/decode use the
+static-shape detection ops (ops/contrib_det.py), so the whole
+forward+loss compiles under jit — the reference's dynamic-shape risk item
+(SURVEY §7) resolved with the padded-output convention.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, _from_jax
+from ..block import HybridBlock
+from .. import nn
+
+
+class SSDAnchorGenerator(HybridBlock):
+    """Per-feature-map anchors (reference: MultiBoxPrior usage in
+    symbol_builder)."""
+
+    def __init__(self, sizes, ratios, **kwargs):
+        super().__init__(**kwargs)
+        self._sizes = tuple(sizes)
+        self._ratios = tuple(ratios)
+
+    @property
+    def num_anchors(self):
+        return len(self._sizes) + len(self._ratios) - 1
+
+    def hybrid_forward(self, F, x):
+        return F.MultiBoxPrior(x, sizes=self._sizes, ratios=self._ratios)
+
+
+class SSD(HybridBlock):
+    """Compact SSD with a configurable backbone.
+
+    Returns (cls_preds (B,C+1,N), loc_preds (B,N*4), anchors (1,N,4)).
+    """
+
+    def __init__(self, num_classes=20, base_channels=(32, 64, 128),
+                 scale_sizes=((0.2,), (0.4,), (0.7,)),
+                 scale_ratios=((1, 2, 0.5),) * 3, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        with self.name_scope():
+            self.stages = nn.HybridSequential(prefix="backbone_")
+            with self.stages.name_scope():
+                for c in base_channels:
+                    blk = nn.HybridSequential(prefix=f"stage{c}_")
+                    with blk.name_scope():
+                        blk.add(nn.Conv2D(c, 3, padding=1,
+                                          use_bias=False),
+                                nn.BatchNorm(),
+                                nn.Activation("relu"),
+                                nn.MaxPool2D(2))
+                    self.stages.add(blk)
+            self.anchor_gens = []
+            self.cls_heads = nn.HybridSequential(prefix="cls_")
+            self.loc_heads = nn.HybridSequential(prefix="loc_")
+            for i, (sizes, ratios) in enumerate(zip(scale_sizes,
+                                                    scale_ratios)):
+                gen = SSDAnchorGenerator(sizes, ratios,
+                                         prefix=f"anchor{i}_")
+                self.anchor_gens.append(gen)
+                setattr(self, f"anchor_gen{i}", gen)
+                na = gen.num_anchors
+                with self.cls_heads.name_scope():
+                    self.cls_heads.add(nn.Conv2D(
+                        na * (num_classes + 1), 3, padding=1))
+                with self.loc_heads.name_scope():
+                    self.loc_heads.add(nn.Conv2D(na * 4, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        cls_preds, loc_preds, anchors = [], [], []
+        stages = list(self.stages._children.values())
+        cls_heads = list(self.cls_heads._children.values())
+        loc_heads = list(self.loc_heads._children.values())
+        for stage, gen, cls_head, loc_head in zip(
+                stages, self.anchor_gens, cls_heads, loc_heads):
+            x = stage(x)
+            anchors.append(gen(x))
+            c = cls_head(x)          # (B, A*(C+1), H, W)
+            l = loc_head(x)          # (B, A*4, H, W)
+            B = c.shape[0]
+            cls_preds.append(
+                F.reshape(F.transpose(c, axes=(0, 2, 3, 1)),
+                          (B, -1, self.num_classes + 1)))
+            loc_preds.append(
+                F.reshape(F.transpose(l, axes=(0, 2, 3, 1)), (B, -1)))
+        cls_all = F.concat(*cls_preds, dim=1)     # (B, N, C+1)
+        loc_all = F.concat(*loc_preds, dim=1)     # (B, N*4)
+        anc_all = F.concat(*anchors, dim=1)       # (1, N, 4)
+        return (F.transpose(cls_all, axes=(0, 2, 1)), loc_all, anc_all)
+
+
+def _ssd_loss_pure(cls_p, loc_p, anc, lab, ratio=3):
+    """cls_p (B,C+1,N), loc_p (B,N*4), anc (1,N,4), lab (B,M,5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.contrib_det import multibox_target
+
+    loc_t, loc_m, cls_t = multibox_target(
+        anc, lab, jax.nn.softmax(cls_p, axis=1),
+        negative_mining_ratio=ratio)
+    # classification: CE over anchors with cls_t >= 0 (mined-out negatives
+    # carry ignore_label and drop out)
+    logp = jax.nn.log_softmax(cls_p, axis=1)         # (B, C+1, N)
+    tgt = jnp.maximum(cls_t, 0).astype(jnp.int32)    # (B, N)
+    nll = -jnp.take_along_axis(logp, tgt[:, None, :], axis=1)[:, 0]
+    valid = (cls_t >= 0)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    cls_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+    # localization: smooth-L1 on matched anchors
+    diff = (loc_p - loc_t) * loc_m
+    absd = jnp.abs(diff)
+    sl1 = jnp.where(absd < 1.0, 0.5 * diff * diff, absd - 0.5)
+    pos = jnp.maximum(jnp.sum(loc_m) / 4.0, 1.0)
+    loc_loss = jnp.sum(sl1) / pos
+    return cls_loss + loc_loss
+
+
+class SSDTrainLoss(HybridBlock):
+    """MultiBoxTarget + cls CE (ignoring mined-out negatives) + smooth-L1
+    loc loss (reference: example/ssd training_targets + MultiBoxTarget).
+
+    Routed through the invoke layer so the whole loss records ONE tape
+    node eagerly and traces pure under jit."""
+
+    def __init__(self, negative_mining_ratio=3, **kwargs):
+        super().__init__(**kwargs)
+        self._ratio = negative_mining_ratio
+
+    def hybrid_forward(self, F, outputs, label):
+        import functools
+
+        from ...ndarray.register import invoke_simple
+
+        cls_preds, loc_preds, anchors = outputs
+        fn = functools.partial(_ssd_loss_pure, ratio=self._ratio)
+        fn.__name__ = "ssd_loss"
+        return invoke_simple(fn, (cls_preds, loc_preds, anchors, label))
+
+
+def ssd_detect(net, x, nms_threshold=0.45, score_threshold=0.01,
+               nms_topk=400):
+    """Inference: forward + MultiBoxDetection decode (reference:
+    example/ssd/demo.py path).  Returns (B, N, 6) [id, score, box]."""
+    import jax
+
+    from ... import ndarray as nd
+
+    cls_preds, loc_preds, anchors = net(x)
+    probs = nd.softmax(nd.transpose(cls_preds, axes=(0, 2, 1)),
+                       axis=-1)  # (B, N, C+1)
+    probs = nd.transpose(probs, axes=(0, 2, 1))  # (B, C+1, N)
+    return nd.MultiBoxDetection(probs, loc_preds, anchors,
+                                nms_threshold=nms_threshold,
+                                threshold=score_threshold,
+                                nms_topk=nms_topk)

@@ -21,6 +21,7 @@ from . import ctc
 from . import rnn as rnn_op
 from . import attention
 from . import contrib_det
+from . import quantization
 
 # Re-export every registered pure function at module level so that
 # `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.  A

@@ -1,0 +1,135 @@
+"""Quantization ops (int8 inference).
+
+Reference parity: src/operator/quantization/ (≥1.2) — quantize/quantize_v2,
+dequantize, requantize, quantized_fully_connected, quantized_conv, and the
+calibration helpers behind contrib.quantization.quantize_model.
+
+TPU-first: int8 matmuls run on the MXU via lax.dot_general with int32
+accumulation (the TPU analog of the reference's cuDNN/MKLDNN int8 paths);
+scales ride alongside as min/max pairs exactly like the reference's
+(data, min, max) triples.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_contrib_quantize", aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="int8"):
+    """Affine-quantize to int8 using given range (reference:
+    quantize.cc).  Returns (q, min, max)."""
+    if out_type != "int8":
+        raise NotImplementedError("only int8 quantization on TPU")
+    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                            jnp.abs(max_range)), 1e-8)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    r = 127.0 / scale
+    return q, -r, r
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",))
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Quantize with self-computed or calibrated range (reference:
+    quantize_v2.cc)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(min_calib_range)
+        mx = jnp.asarray(max_calib_range)
+    return quantize(data, mn, mx, out_type)
+
+
+def _quant_levels(dtype):
+    """int8 → 127, int32 → 2^31-1 (reference range convention: the
+    min/max pair spans the full quantized dtype range)."""
+    if jnp.dtype(dtype) == jnp.int32:
+        return 2147483647.0
+    return 127.0
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    levels = _quant_levels(data.dtype)
+    scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / levels
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", aliases=("requantize",))
+def requantize(data, min_range, max_range, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator → int8 with a new range (reference:
+    requantize.cc)."""
+    f = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        / _quant_levels(data.dtype))
+    if min_calib_range is None:
+        mn, mx = jnp.min(f), jnp.max(f)
+    else:
+        mn, mx = jnp.asarray(min_calib_range), \
+            jnp.asarray(max_calib_range)
+    return quantize(f, mn, mx, out_type)
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None,
+                              no_bias=False, flatten=True):
+    """int8 × int8 → int32 FC on the MXU (reference:
+    quantized_fully_connected.cc).  Returns (out_i32, min_out, max_out)."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    s_data = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    s_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    out_scale = s_data * s_w
+    if bias is not None and not no_bias:
+        s_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        b_i32 = jnp.round(bias.astype(jnp.float32) * s_b
+                          / out_scale).astype(jnp.int32)
+        out = out + b_i32
+    r = 2147483647.0 * out_scale
+    return out, -r, r
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=None,
+                   stride=None, pad=None, num_filter=None, num_group=1,
+                   no_bias=False, layout=None):
+    """int8 convolution with int32 accumulation (reference:
+    quantized_conv.cc)."""
+    from .nn import _pair, _conv_dn
+
+    nd = data.ndim
+    spatial = nd - 2
+    stride = _pair(stride or 1, spatial)
+    pad_t = _pair(pad or 0, spatial)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dn(nd))
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad_t],
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    s_data = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    s_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    out_scale = s_data * s_w
+    if bias is not None and not no_bias:
+        s_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        b_i32 = jnp.round(bias.astype(jnp.float32) * s_b
+                          / out_scale).astype(jnp.int32)
+        out = out + b_i32.reshape((1, -1) + (1,) * spatial)
+    r = 2147483647.0 * out_scale
+    return out, -r, r

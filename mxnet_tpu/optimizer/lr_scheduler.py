@@ -106,16 +106,17 @@ class PolyScheduler(LRScheduler):
         self.base_lr_orig = self.base_lr
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max(1, self.max_update - self.warmup_steps)
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         if num_update <= self.max_update:
+            frac = min(1.0, float(num_update - self.warmup_steps)
+                       / float(self.max_steps))
             self.base_lr = (self.final_lr
                             + (self.base_lr_orig - self.final_lr)
-                            * pow(1 - float(num_update - self.warmup_steps)
-                                  / float(self.max_steps), self.power))
+                            * pow(1 - frac, self.power))
         return self.base_lr
 
 
@@ -132,15 +133,15 @@ class CosineScheduler(LRScheduler):
         self.base_lr_orig = base_lr
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max(1, self.max_update - self.warmup_steps)
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         if num_update <= self.max_update:
+            frac = min(1.0, float(num_update - self.warmup_steps)
+                       / float(self.max_steps))
             self.base_lr = (self.final_lr
                             + (self.base_lr_orig - self.final_lr)
-                            * (1 + math.cos(
-                                math.pi * (num_update - self.warmup_steps)
-                                / self.max_steps)) / 2)
+                            * (1 + math.cos(math.pi * frac)) / 2)
         return self.base_lr

@@ -1,0 +1,216 @@
+"""Aux subsystem tests: profiler, runtime, amp, checkpoint, quantization,
+gluon.contrib, visualization, symbol shape rules (SURVEY §5 parity)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+
+
+def test_profiler_chrome_trace(tmp_path):
+    f = str(tmp_path / "prof.json")
+    mx.profiler.set_config(profile_all=True, filename=f)
+    mx.profiler.set_state("run")
+    a = mx.nd.ones((8, 8))
+    (a * a).sum().wait_to_read()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    with open(f) as fh:
+        trace = json.load(fh)
+    assert len(trace["traceEvents"]) >= 2
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "broadcast_mul" in names or "sum" in names
+    summary = mx.profiler.get_summary(reset=True)
+    assert "sum" in summary
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert len(mx.runtime.feature_list()) > 10
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NOT_A_FEATURE")
+
+
+def test_amp_bf16_block():
+    mx.amp.init("bfloat16")
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    mx.amp.convert_block(net)
+    import jax.numpy as jnp
+
+    assert net.weight.data()._data.dtype == jnp.bfloat16
+    out = net(mx.nd.ones((2, 3)).astype("bfloat16" if hasattr(np, "bf16")
+                                        else np.float32)
+              .astype(jnp.bfloat16))
+    assert out.shape == (2, 4)
+
+
+def test_amp_loss_scaler():
+    s = mx.amp.DynamicLossScaler(init_scale=1024.0, scale_window=2)
+    assert s.update_scale(True) == 512.0
+    s.update_scale(False)
+    assert s.update_scale(False) == 1024.0
+    assert s.has_overflow([mx.nd.array([np.inf])])
+    assert not s.has_overflow([mx.nd.array([1.0])])
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.random_normal(shape=(6, 6))
+    q, mn, mxr = mx.nd.quantize_v2(x)
+    assert q.dtype == np.int8
+    back = mx.nd.dequantize(q, mn, mxr)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=0.05)
+
+
+def test_quantized_fc_matches_fp():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 8).astype(np.float32))
+    w = mx.nd.array(rng.randn(5, 8).astype(np.float32))
+    q, qmin, qmax = mx.nd.quantize_v2(x)
+    qw, wmin, wmax = mx.nd.quantize_v2(w)
+    out, omin, omax = mx.nd.quantized_fully_connected(
+        q, qw, None, qmin, qmax, wmin, wmax, no_bias=True, num_hidden=5)
+    assert out.dtype == np.int32
+    deq = mx.nd.dequantize(out, omin, omax).asnumpy()
+    ref = x.asnumpy() @ w.asnumpy().T
+    rel = np.abs(deq - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_quantize_block_calibration():
+    from mxnet_tpu.contrib import quantization
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    data = [mx.nd.random_normal(shape=(2, 3)) for _ in range(3)]
+    net, ranges = quantization.quantize_block(net, calib_data=data,
+                                              num_calib_batches=2)
+    assert "__input__" in ranges and "__output__" in ranges
+
+
+def test_contrib_layers():
+    from mxnet_tpu.gluon import contrib
+
+    ident = contrib.nn.Identity()
+    x = mx.nd.ones((2, 3))
+    np.testing.assert_allclose(ident(x).asnumpy(), x.asnumpy())
+
+    ps = contrib.nn.PixelShuffle2D(2)
+    out = ps(mx.nd.random_normal(shape=(1, 8, 3, 3)))
+    assert out.shape == (1, 2, 6, 6)
+
+    sbn = contrib.nn.SyncBatchNorm(in_channels=4, num_devices=8)
+    sbn.initialize()
+    assert sbn(mx.nd.random_normal(shape=(2, 4))).shape == (2, 4)
+
+
+def test_contrib_conv_lstm():
+    from mxnet_tpu.gluon import contrib
+
+    cell = contrib.rnn.Conv2DLSTMCell((3, 6, 6), 4)
+    cell.initialize()
+    outs, st = cell.unroll(2, mx.nd.ones((2, 2, 3, 6, 6)), layout="NTC",
+                           merge_outputs=False)
+    assert outs[0].shape == (2, 4, 6, 6)
+    assert st[0].shape == (2, 4, 6, 6)
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    from mxnet_tpu import checkpoint
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    tr = parallel.ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
+                                 {"learning_rate": 0.01},
+                                 mesh=parallel.make_mesh(dp=2))
+    x = np.ones((4, 3), np.float32)
+    y = np.zeros((4, 4), np.float32)
+    tr.step(x, y)
+    w_after_1 = None
+    ck = checkpoint.ShardedCheckpointer(str(tmp_path / "ckpt"),
+                                        async_save=False)
+    state = checkpoint.trainer_state(tr)
+    ck.save(1, state)
+    w_after_1 = np.asarray(tr._param_vals[0])
+    tr.step(x, y)  # move past the saved state
+    restored = ck.restore(1, template=checkpoint.trainer_state(tr))
+    checkpoint.load_trainer_state(tr, restored)
+    np.testing.assert_allclose(np.asarray(tr._param_vals[0]), w_after_1)
+    assert tr._num_update == 1
+    ck.close()
+
+
+def test_estimator_fit():
+    from mxnet_tpu.gluon.contrib import Estimator
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 5).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    loader = gluon.data.DataLoader(ds, batch_size=16)
+    net = nn.Dense(2, in_units=5)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=["acc"], trainer=trainer)
+    est.fit(loader, epochs=3)
+    acc = est.evaluate(loader)[0]
+    assert acc[1] > 0.7
+
+
+def test_detection_ops():
+    iou = mx.nd.box_iou(mx.nd.array([[0, 0, 2, 2]]),
+                        mx.nd.array([[1, 1, 3, 3]]))
+    np.testing.assert_allclose(iou.asnumpy(), [[1.0 / 7.0]], rtol=1e-5)
+
+    det = mx.nd.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                        [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                        [1, 0.7, 0.6, 0.6, 0.9, 0.9]]])
+    out = mx.nd.box_nms(det, overlap_thresh=0.5, coord_start=2,
+                        score_index=1, id_index=0)
+    scores = out.asnumpy()[0, :, 1]
+    np.testing.assert_allclose(scores, [0.9, -1.0, 0.7], rtol=1e-5)
+
+    anchors = mx.nd.MultiBoxPrior(mx.nd.zeros((1, 3, 4, 4)),
+                                  sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+
+
+def test_multibox_target_matching():
+    anchors = mx.nd.MultiBoxPrior(mx.nd.zeros((1, 3, 4, 4)),
+                                  sizes=(0.5,), ratios=(1.0,))
+    lab = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0]]], np.float32))
+    pred = mx.nd.array(np.random.rand(1, 3, 16).astype(np.float32))
+    lt, lm, ct = mx.nd.MultiBoxTarget(anchors, lab, pred,
+                                      negative_mining_ratio=3)
+    ctn = ct.asnumpy()
+    assert (ctn > 0).sum() == 1     # force-matched anchor
+    assert (ctn == 0).sum() == 3    # 3:1 mined negatives
+    assert (ctn == -1).sum() == 12  # rest ignored
+
+
+def test_roi_align_values():
+    # constant image → every pooled cell is that constant
+    img = mx.nd.ones((1, 2, 8, 8)) * 3.0
+    rois = mx.nd.array([[0, 0, 0, 7, 7]])
+    out = mx.nd.roi_align(img, rois, pooled_size=(2, 2))
+    np.testing.assert_allclose(out.asnumpy(), 3.0 * np.ones((1, 2, 2, 2)),
+                               rtol=1e-5)
+
+
+def test_visualization_summary():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    out = mx.visualization.print_summary(net)
+    assert "Total params" in out and "16" in out
