@@ -1,3 +1,4 @@
+#!/usr/bin/env python
 """Benchmark entry point (driver contract: prints ONE JSON line).
 
 Metric: ResNet-50 training throughput in samples/sec/chip (the BASELINE.md
@@ -5,29 +6,141 @@ headline).  The whole training step — forward, backward, SGD+momentum
 update, BatchNorm stat updates — runs as ONE compiled XLA program
 (parallel.ShardedTrainer) in bfloat16 compute on the MXU.
 
+Round-2 hardening (VERDICT.md "Next round" #1/#2): the orchestrator
+process never imports jax.  It runs the actual benchmark in a worker
+subprocess with a time budget, falls back to smaller configs and then to
+the CPU backend if TPU init fails or hangs, and ALWAYS prints exactly one
+structured JSON line.  Workers use a persistent XLA compilation cache
+(.jax_cache/) so the driver's run pays no recompile if the repo was
+benched during the round.  An MFU estimate is included (analytic
+FLOPs/sample ÷ device peak).
+
 vs_baseline is null: BASELINE.json.published is {} (reference mount was
 empty — see BASELINE.md provenance note).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+_HOSTILE_ENV_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU")
 
-def main():
+# bf16 peak FLOP/s per chip by device kind substring (public specs)
+_PEAK_FLOPS = [
+    ("v6e", 918e12), ("v6", 918e12),
+    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+
+# ResNet-50 @224: ~4.09e9 MACs fwd => 8.2e9 FLOPs; training ~= 3x fwd
+_RESNET50_TRAIN_FLOPS_224 = 3.0 * 2 * 4.089e9
+
+
+def _attempts():
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    budget = int(os.environ.get("BENCH_BUDGET", 560))
+    tpu_attempts = [] if os.environ.get("BENCH_SKIP_TPU") else [
+        (None, {"batch": int(os.environ.get("BENCH_BATCH", 256)),
+                "image": int(os.environ.get("BENCH_IMAGE", 224)),
+                "steps": steps, "backend": "tpu"}, budget),
+        (None, {"batch": 64, "image": 224, "steps": 10, "backend": "tpu"},
+         min(300, budget)),
+    ]
+    return tpu_attempts + [
+        ({"JAX_PLATFORMS": "cpu"},
+         {"batch": 8, "image": 32, "steps": 3, "backend": "cpu"}, 240),
+    ]
+
+
+def orchestrate():
+    errors = []
+    for env_over, cfg, budget in _attempts():
+        env = dict(os.environ)
+        if env_over is not None:
+            # CPU fallback: strip anything that could claim the tunnel
+            env = {k: v for k, v in env.items()
+                   if not k.startswith(_HOSTILE_ENV_PREFIXES)}
+            env.update(env_over)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 json.dumps(cfg)],
+                env=env, timeout=budget, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            errors.append(f"{cfg['backend']} b{cfg['batch']}: "
+                          f"timeout {budget}s")
+            continue
+        line = None
+        for ln in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(ln)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                line = ln
+                break
+        if proc.returncode == 0 and line:
+            print(line)
+            return 0
+        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+        errors.append(f"{cfg['backend']} b{cfg['batch']}: rc="
+                      f"{proc.returncode} {tail.splitlines()[-1] if tail else ''}")
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip",
+        "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": None,
+        "error": "; ".join(errors)[-500:],
+    }))
+    return 0
+
+
+def worker(cfg):
+    import jax
+
+    # backend init guard: one retry, then a distinct rc for the parent
+    devices = None
+    for attempt in range(2):
+        try:
+            devices = jax.devices()
+            break
+        except RuntimeError as e:
+            sys.stderr.write(f"backend init failed ({e}); "
+                             f"attempt {attempt}\n")
+            time.sleep(8)
+    if devices is None:
+        sys.exit(3)
+    if cfg["backend"] != "cpu" and devices[0].platform == "cpu":
+        # jax fell back to CPU on a chip-less host: don't burn the TPU
+        # attempt's budget running ResNet-50 on CPU — bail so the parent
+        # moves straight to the sized-for-CPU fallback config
+        sys.stderr.write("requested TPU but only CPU available\n")
+        sys.exit(4)
+
+    # persistent compile cache so the driver's bench run pays no
+    # recompile; TPU only (XLA:CPU AOT caches are host-specific)
+    if devices[0].platform != "cpu":
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass  # cache is best-effort
+
     import numpy as np
+
+    import jax.numpy as jnp
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
-    import jax
-
-    n_chips = max(1, len(jax.devices()))
-    batch_size = int(os.environ.get("BENCH_BATCH", 64))
-    image_size = int(os.environ.get("BENCH_IMAGE", 224))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    n_chips = max(1, len(devices))
+    batch_size, image_size, steps = cfg["batch"], cfg["image"], cfg["steps"]
 
     net = vision.resnet50_v1(classes=1000)
     net.initialize(init=mx.init.Xavier())
@@ -39,11 +152,8 @@ def main():
         {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
 
     rng = np.random.RandomState(0)
-    x = rng.standard_normal((batch_size, 3, image_size, image_size)) \
-        .astype("bfloat16" if hasattr(np, "bfloat16") else "float32")
-    import jax.numpy as jnp
-
-    x = jnp.asarray(x, dtype=jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal(
+        (batch_size, 3, image_size, image_size)), dtype=jnp.bfloat16)
     y = jnp.asarray(rng.randint(0, 1000, batch_size).astype("float32"))
 
     # warmup / compile
@@ -59,13 +169,33 @@ def main():
 
     samples_per_sec = batch_size * steps / dt
     per_chip = samples_per_sec / n_chips
+
+    kind = getattr(devices[0], "device_kind", "") or ""
+    peak = None
+    for key, val in _PEAK_FLOPS:
+        if key in kind.lower():
+            peak = val
+            break
+    flops_per_sample = (_RESNET50_TRAIN_FLOPS_224
+                        * (image_size / 224.0) ** 2)
+    mfu = (round(per_chip * flops_per_sample / peak, 4)
+           if peak else None)
+
     print(json.dumps({
         "metric": "resnet50_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": None,
+        "mfu": mfu,
+        "device_kind": kind,
+        "backend": devices[0].platform,
+        "batch": batch_size,
+        "image": image_size,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        worker(json.loads(sys.argv[2]))
+    else:
+        sys.exit(orchestrate())

@@ -23,6 +23,42 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
+def _conv_f32_accum(data, weight, **cfg):
+    """conv with f32 MXU accumulation in the forward pass.
+
+    jax's conv transpose rule rejects ``preferred_element_type=f32`` with
+    low-precision operands (the f32 cotangent meets the bf16 kernel), so
+    for bf16/fp16 we wrap in a custom_vjp: forward accumulates f32 on the
+    MXU, backward runs dgrad/wgrad as native-dtype convs (cuDNN
+    tensor-core parity — the TPU MXU still accumulates f32 internally).
+    """
+    if data.dtype == weight.dtype:
+        if data.dtype == jnp.float32:
+            return lax.conv_general_dilated(
+                data, weight, preferred_element_type=jnp.float32, **cfg)
+        if data.dtype == jnp.float64:
+            # f64 already accumulates wide; a narrower preferred raises
+            return lax.conv_general_dilated(data, weight, **cfg)
+
+    @jax.custom_vjp
+    def conv(d, w):
+        return lax.conv_general_dilated(
+            d, w, preferred_element_type=jnp.float32,
+            **cfg).astype(d.dtype)
+
+    def fwd(d, w):
+        return conv(d, w), (d, w)
+
+    def bwd(res, g):
+        d, w = res
+        _, vjp = jax.vjp(
+            lambda d_, w_: lax.conv_general_dilated(d_, w_, **cfg), d, w)
+        return vjp(g.astype(d.dtype))
+
+    conv.defvjp(fwd, bwd)
+    return conv(data, weight)
+
+
 # -- linear --------------------------------------------------------------------
 
 @register("FullyConnected", aliases=("fully_connected",))
@@ -209,14 +245,13 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _pair(dilate or 1, spatial)
     pad = _pair(pad or 0, spatial)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
-    out = lax.conv_general_dilated(
+    out = _conv_f32_accum(
         data, weight,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
     ).astype(data.dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * spatial)
@@ -263,14 +298,13 @@ def _deconv_one(data, weight, stride, padding, dilate):
     w = jnp.flip(weight, axis=tuple(range(2, nd)))
     w = jnp.swapaxes(w, 0, 1)  # IO* -> OI* for the underlying conv
     dn2 = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dn(nd))
-    return lax.conv_general_dilated(
+    return _conv_f32_accum(
         data, w,
         window_strides=(1,) * (nd - 2),
         padding=padding,
         lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=dn2,
-        preferred_element_type=jnp.float32,
     ).astype(data.dtype)
 
 

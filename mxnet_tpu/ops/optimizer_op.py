@@ -60,7 +60,11 @@ def adam_update_pure(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                      clip_gradient=-1.0, lazy_update=True):
     # bias correction is folded into `lr` by the Optimizer (reference
     # behavior: python/mxnet/optimizer/optimizer.py Adam computes lr_t).
-    grad = _rescale(grad, rescale_grad, clip_gradient) + wd * weight
+    # reference AdamUpdate clips AFTER adding weight decay:
+    # grad = clip(rescale*grad + wd*weight)
+    grad = grad * rescale_grad + wd * weight
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
     mean = beta1 * mean + (1.0 - beta1) * grad
     var = beta2 * var + (1.0 - beta2) * jnp.square(grad)
     return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var

@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a Pallas TPU kernel — forward AND backward.
 
 Reference parity target: the fused MHA kernels the reference gets from
 contrib/transformer.cu + cuDNN; here the TPU version is a blockwise
@@ -10,11 +10,15 @@ materializes in HBM:
 - the score block Q·Kᵀ runs on the MXU with f32 accumulation;
 - m/l/o accumulators live in VMEM scratch across the inner loop;
 - causal masking skips fully-masked KV blocks (upper-triangle blocks are
-  never even loaded — the index map keeps them out of the loop bound).
+  never even loaded — the index map keeps them out of the loop bound);
+- the forward also emits the per-row logsumexp L = m + log(l), and the
+  backward is the FlashAttention-2 recipe: recompute the probability
+  block p = exp(s − L) per tile and accumulate dq (one kernel, grid over
+  q blocks) and dk/dv (one kernel, grid over kv blocks) in VMEM — no
+  O(T²) HBM tensor in training either.
 
-Off-TPU (tests, CPU mesh) the kernel runs in interpret mode, keeping one
-code path.  Backward currently flows through ``jax.custom_vjp`` with a
-recompute-based pullback built on the same kernel primitives.
+Off-TPU (tests, CPU mesh) the kernels run in interpret mode, keeping one
+code path.
 """
 
 from __future__ import annotations
@@ -32,8 +36,20 @@ def _use_interpret():
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
-                      scale, q_block, seq_len):
+def _block_sizes(T):
+    block_q = min(max(_LANE, 1), T)
+    while T % block_q:
+        block_q //= 2
+    block_k = min(_LANE, T)
+    while T % block_k:
+        block_k //= 2
+    return block_q, block_k
+
+
+# -- forward -------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                      causal, scale, q_block, seq_len):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -74,34 +90,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
     l0 = jnp.zeros((Bq,), jnp.float32)
     m0 = jnp.full((Bq,), _NEG, jnp.float32)
     o, l, m = jax.lax.fori_loop(0, nkb, body, (o0, l0, m0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
-    return _flash_call(q, k, v, causal, scale)
+    lsafe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (o / lsafe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(lsafe)
 
 
 def _flash_call(q, k, v, causal, scale):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
     qr = q.reshape(B * H, T, D)
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
-    block_q = min(max(_LANE, 1), T)
-    while T % block_q:
-        block_q //= 2
-    block_k = min(_LANE, T)
-    while T % block_k:
-        block_k //= 2
+    block_q, block_k = _block_sizes(T)
     grid = (B * H, T // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
         q_block=block_q, seq_len=T)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -109,14 +115,183 @@ def _flash_call(q, k, v, causal, scale):
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
         interpret=_use_interpret(),
     )(qr, kr, vr)
-    return out.reshape(B, H, T, D)
+    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+# -- backward (FlashAttention-2) -----------------------------------------------
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                     dq_ref, *, block_k, causal, scale, q_block, seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)      # (Bq, D)
+    g = g_ref[0].astype(jnp.float32)      # (Bq, D)
+    lse = lse_ref[0]                      # (Bq,)
+    delta = delta_ref[0]                  # (Bq,)
+    Bq, D = q.shape
+    nkb = pl.cdiv(seq_len, block_k)
+    if causal:
+        q_end = (qi + 1) * q_block - 1
+        nkb = jnp.minimum(nkb, (q_end // block_k) + 1)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (Bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        dp = jax.lax.dot_general(                      # dO · Vᵀ
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((Bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_q, causal, scale, k_block,
+                      seq_len):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)      # (Bk, D)
+    v = v_ref[0].astype(jnp.float32)      # (Bk, D)
+    Bk, D = k.shape
+    nqb = pl.cdiv(seq_len, block_q)
+    # causal: q block rows strictly above this kv block are fully masked
+    start = (ki * k_block) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, Bk), 0)
+            kpos = ki * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, Bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        dv = dv + jax.lax.dot_general(                  # Pᵀ · dO
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(                  # dSᵀ · Q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((Bk, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nqb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_call(q, k, v, out, lse, g, causal, scale):
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+    gr = g.reshape(B * H, T, D)
+    lser = lse.reshape(B * H, T)
+    # D_i = rowsum(dO ∘ O) — tiny, XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(B * H, T)
+    block_q, block_k = _block_sizes(T)
+    interpret = _use_interpret()
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_block=block_q, seq_len=T)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
+        k_block=block_k, seq_len=T)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, T), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
+
+
+# -- custom vjp ----------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, causal, scale):
+    out, _ = _flash_call(q, k, v, causal, scale)
+    return out
 
 
 def _dense_ref(q, k, v, causal, scale):
+    """Dense oracle for tests (and the doc of what the kernel computes)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -129,18 +304,13 @@ def _dense_ref(q, k, v, causal, scale):
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash_call(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_call(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, res, g):
-    # recompute-based backward through the dense reference: numerically
-    # identical gradients; a blockwise Pallas backward is the planned
-    # optimization (forward dominates inference; training long-context
-    # uses ring attention whose scan JAX transposes natively)
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_ref(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_call(q, k, v, out, lse, g, causal, scale)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

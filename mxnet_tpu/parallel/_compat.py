@@ -1,0 +1,59 @@
+"""jax API compatibility shims, pinned in ONE place.
+
+Minimum supported jax: 0.4.35 (first release with `jax.shard_map`
+promoted out of experimental).  Newer jax deprecates
+``jax.experimental.shard_map`` and ``lax.pvary`` — prefer the stable
+spellings, fall back for older installs.
+"""
+
+from __future__ import annotations
+
+MIN_JAX_VERSION = "0.4.35"
+
+
+_SM_INFO = None  # (callable, replication-check kwarg name or None)
+
+
+def _resolve_shard_map():
+    global _SM_INFO
+    if _SM_INFO is None:
+        import inspect
+
+        import jax
+
+        if hasattr(jax, "shard_map"):
+            fn = jax.shard_map
+        else:
+            from jax.experimental.shard_map import shard_map as fn
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            params = {}
+        kw = ("check_vma" if "check_vma" in params
+              else "check_rep" if "check_rep" in params else None)
+        _SM_INFO = (fn, kw)
+    return _SM_INFO
+
+
+def shard_map(*args, **kwargs):
+    fn, kw = _resolve_shard_map()
+    # normalize the replication-check kwarg to whatever this jax spells it
+    val = kwargs.pop("check_rep", kwargs.pop("check_vma", None))
+    if val is not None and kw is not None:
+        kwargs[kw] = val
+    return fn(*args, **kwargs)
+
+
+def pvary(x, axis_names):
+    """Mark an array as varying over `axis_names` inside shard_map."""
+    import jax
+    from jax import lax
+
+    try:
+        if set(axis_names) <= set(jax.typeof(x).vma):
+            return x  # already varying
+    except Exception:
+        pass
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axis_names), to="varying")
+    return lax.pvary(x, tuple(axis_names))

@@ -24,7 +24,7 @@ from jax.lax import (all_gather, all_to_all, axis_index,  # noqa: F401
 def _allreduce_fn(mesh, axes):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
-    from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map
 
     spec = PartitionSpec(axes)
 

@@ -34,7 +34,7 @@ def pipeline_apply(stage_fn, params_stacked, x_micro, mesh=None, axis=PP):
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map
     from jax.sharding import PartitionSpec
 
     mesh = mesh or default_mesh()

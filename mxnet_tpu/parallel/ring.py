@@ -29,17 +29,9 @@ _NEG_INF = -1e30
 def _pvary(x, axis):
     """Mark an array as varying over `axis` inside shard_map (needed for
     scan/fori carries whose body mixes in device-dependent values)."""
-    import jax
-    from jax import lax
+    from ._compat import pvary
 
-    try:
-        if axis in jax.typeof(x).vma:
-            return x  # already varying
-    except Exception:
-        pass
-    if hasattr(lax, "pvary"):
-        return lax.pvary(x, (axis,))
-    return lax.pcast(x, (axis,), to="varying")
+    return pvary(x, (axis,))
 
 
 def _place(mesh, spec, *arrays):
@@ -119,7 +111,7 @@ def ring_attention(q, k, v, mesh=None, axis=SP, causal=False, scale=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec
 
     mesh = mesh or default_mesh()
@@ -174,7 +166,7 @@ def ulysses_attention(q, k, v, mesh=None, axis=SP, causal=False,
     """
     import jax.numpy as jnp
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from ._compat import shard_map
     from jax.sharding import PartitionSpec
 
     import jax

@@ -38,7 +38,9 @@ class _PureOptimizer:
 
     def __init__(self, name, lr=0.01, momentum=0.0, wd=0.0, beta1=0.9,
                  beta2=0.999, epsilon=1e-8, clip_gradient=None,
-                 lr_scheduler=None, **_ignored):
+                 lr_scheduler=None, gamma1=None, rho=None, gamma2=0.9,
+                 centered=False, lower_bound=None, upper_bound=None,
+                 clip_weights=None, lazy_update=True, **unknown):
         self.name = name.lower()
         self.lr = lr
         self.momentum = momentum
@@ -48,6 +50,23 @@ class _PureOptimizer:
         self.epsilon = epsilon
         self.clip_gradient = clip_gradient
         self.lr_scheduler = lr_scheduler
+        # rmsprop decay: reference calls it gamma1, torch-style calls rho
+        self.gamma1 = gamma1 if gamma1 is not None else \
+            (rho if rho is not None else 0.9)
+        self.gamma2 = gamma2
+        self.centered = bool(centered)
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.clip_weights = clip_weights
+        if unknown:
+            # reference-compatible knobs with no effect here (grads are
+            # mean-normalized inside the compiled step; compute dtype is
+            # set by block.cast) — warn, don't crash ported scripts
+            import warnings
+
+            warnings.warn(
+                f"ShardedTrainer: ignoring optimizer hyperparameters "
+                f"{sorted(unknown)} for {name}", stacklevel=3)
         if self.name not in ("sgd", "nag", "adam", "adamw", "lamb",
                              "rmsprop", "adagrad"):
             raise MXNetError(f"ShardedTrainer: unsupported optimizer "
@@ -59,7 +78,9 @@ class _PureOptimizer:
         return self.lr
 
     def n_states(self):
-        return {"sgd": 1, "nag": 1, "adagrad": 1, "rmsprop": 1,
+        if self.name == "rmsprop":
+            return 3 if self.centered else 1
+        return {"sgd": 1, "nag": 1, "adagrad": 1,
                 "adam": 2, "adamw": 2, "lamb": 2}[self.name]
 
     def init_state(self, param_vals):
@@ -112,16 +133,39 @@ class _PureOptimizer:
                     beta2=self.beta2, epsilon=self.epsilon, wd=wd, **kw)
                 r1 = jnp.linalg.norm(p)
                 r2 = jnp.linalg.norm(gnew)
-                (w,) = _op.lamb_update_phase2_pure(p, gnew, r1, r2, lr=plr)
+                bounds = {}
+                if self.lower_bound is not None:
+                    bounds["lower_bound"] = self.lower_bound
+                if self.upper_bound is not None:
+                    bounds["upper_bound"] = self.upper_bound
+                (w,) = _op.lamb_update_phase2_pure(p, gnew, r1, r2, lr=plr,
+                                                   **bounds)
                 s_out = (m, v)
             elif self.name == "rmsprop":
-                w, n = _op.rmsprop_update_pure(
-                    p, g, s[0], lr=plr, epsilon=self.epsilon, wd=wd, **kw)
-                s_out = (n,)
+                cw = {"clip_weights": self.clip_weights} \
+                    if self.clip_weights is not None else {}
+                if self.centered:
+                    w, n, gm, d = _op.rmspropalex_update_pure(
+                        p, g, s[0], s[1], s[2], lr=plr, gamma1=self.gamma1,
+                        gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+                        **kw, **cw)
+                    s_out = (n, gm, d)
+                else:
+                    w, n = _op.rmsprop_update_pure(
+                        p, g, s[0], lr=plr, gamma1=self.gamma1,
+                        epsilon=self.epsilon, wd=wd, **kw, **cw)
+                    s_out = (n,)
             elif self.name == "adagrad":
                 w, h = _op.adagrad_update_pure(
                     p, g, s[0], lr=plr, epsilon=self.epsilon, wd=wd, **kw)
                 s_out = (h,)
+            # the f32 lr scalar promotes the update math to f32 — cast
+            # back so bf16 weights stay bf16 across steps (the reference
+            # updaters preserve weight dtype; dtype drift would also
+            # retrace the jitted step every call)
+            w = w.astype(p.dtype)
+            s_out = tuple(s_new.astype(s_old.dtype)
+                          for s_new, s_old in zip(s_out, s))
             new_p.append(w)
             new_s.append(s_out)
         return new_p, new_s
@@ -222,9 +266,9 @@ class ShardedTrainer:
         grad_accum = self._grad_accum
 
         def pure_step(param_vals, opt_state, aux_vals, x, y, key, lr, t):
-            def loss_of(pv, xb, yb, kb):
+            def loss_of(pv, aux_cur, xb, yb, kb):
                 pm = dict(zip(t_ids, pv))
-                pm.update({i: aux_vals[n]
+                pm.update({i: aux_cur[n]
                            for i, n in zip(a_ids, a_names)})
                 prev_map = _TRACE.param_map
                 prev_aux = _TRACE.aux_collector
@@ -243,11 +287,17 @@ class ShardedTrainer:
 
             if grad_accum == 1:
                 (loss, aux_upd), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(param_vals, x, y, key)
+                    loss_of, has_aux=True)(param_vals, aux_vals, x, y, key)
+                new_aux = dict(aux_vals)
+                new_aux.update(aux_upd)
             else:
                 # microbatch the leading dim; one optimizer update from
                 # the averaged gradients (reference grad_req='add' +
-                # delayed trainer.step semantics, compiled)
+                # delayed trainer.step semantics, compiled).  Aux (BN
+                # running stats) threads through the scan carry so each
+                # microbatch applies its momentum update to the stats the
+                # previous microbatch produced — k sequential updates per
+                # step, matching the reference's k forward passes.
                 def reshape(a):
                     return a.reshape((grad_accum, -1) + a.shape[1:])
 
@@ -256,23 +306,22 @@ class ShardedTrainer:
                 keys = jax.random.split(key, grad_accum)
 
                 def body(carry, micro):
-                    l_acc, g_acc = carry
+                    l_acc, g_acc, aux_cur = carry
                     xb, yb, kb = micro
                     (l, aux_upd), g = jax.value_and_grad(
-                        loss_of, has_aux=True)(param_vals, xb, yb, kb)
+                        loss_of, has_aux=True)(param_vals, aux_cur, xb,
+                                               yb, kb)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                    return (l_acc + l, g_acc), aux_upd
+                    aux_next = dict(aux_cur)
+                    aux_next.update(aux_upd)
+                    return (l_acc + l, g_acc, aux_next), None
 
                 g0 = jax.tree_util.tree_map(jnp.zeros_like, param_vals)
-                (l_tot, g_tot), aux_hist = jax.lax.scan(
-                    body, (0.0, g0), (xm, ym, keys))
+                (l_tot, g_tot, new_aux), _ = jax.lax.scan(
+                    body, (0.0, g0, dict(aux_vals)), (xm, ym, keys))
                 loss = l_tot / grad_accum
                 grads = jax.tree_util.tree_map(
                     lambda g: g / grad_accum, g_tot)
-                aux_upd = jax.tree_util.tree_map(lambda a: a[-1],
-                                                 aux_hist)
-            new_aux = dict(aux_vals)
-            new_aux.update(aux_upd)
             # loss_of returns the MEAN loss → grads are already
             # batch-normalized; rescale_grad stays 1 (the reference's
             # rescale=1/batch applies to summed grads)

@@ -28,10 +28,13 @@ _STATE = _KeyState()
 
 
 def seed(seed_state: int, ctx="all") -> None:
-    """mx.random.seed — reseeds the global eager key chain."""
+    """mx.random.seed — reseeds the global eager key chain AND numpy's
+    global RNG (initializers draw from numpy; reference mx.random.seed
+    seeds all device RNGs so weight init is reproducible)."""
     import jax
 
     _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) % (2 ** 32))
 
 
 def _global_key():

@@ -12,6 +12,12 @@ reference's im2rec are readable.
 
 A C++ fast path (src/recordio.cc, loaded via ctypes) handles bulk reads;
 this module is the reference implementation and fallback.
+
+FORMAT NOTE (round 2): the continuation-split framing was corrected to
+exact dmlc-core semantics (aligned-magic excision; reader re-inserts the
+magic).  Files written by the round-1 codec whose records embedded the
+magic are NOT readable by this codec (and were never reference-compatible
+to begin with); re-pack them.
 """
 
 from __future__ import annotations
@@ -100,32 +106,35 @@ class MXRecordIO:
 
     def write(self, buf):
         """Write one record with reference framing (continuation-split on
-        embedded magics)."""
+        embedded magics).
+
+        dmlc-core semantics (3rdparty/dmlc-core/src/recordio.cc
+        RecordIOWriter::WriteRecord): scan only 4-byte-ALIGNED positions
+        for the magic; each embedded aligned magic is EXCISED from the
+        written payload and acts as the chunk delimiter (cflag 1=first,
+        2=middle, 3=last chunk); the reader re-inserts kMagic before every
+        cflag-2/3 chunk.  Unaligned embedded magics are left in place
+        (harmless — framing is aligned).
+        """
         assert self.writable
         self._check_pid()
-        magic = buf.find(struct.pack("<I", _MAGIC))
-        if magic == -1:
+        magic_bytes = struct.pack("<I", _MAGIC)
+        splits = []
+        idx = buf.find(magic_bytes)
+        while idx != -1:
+            if idx % 4 == 0:
+                splits.append(idx)
+                idx = buf.find(magic_bytes, idx + 4)
+            else:
+                idx = buf.find(magic_bytes, idx + 1)
+        if not splits:
             self._write_chunk(0, buf)
-        else:
-            # split into chunks so no payload chunk contains the magic
-            # cflag: 1=start, 2=middle, 3=end of a multi-chunk record
-            chunks = []
-            data = buf
-            while True:
-                idx = data.find(struct.pack("<I", _MAGIC))
-                if idx == -1:
-                    chunks.append(data)
-                    break
-                chunks.append(data[:idx + 2])  # split inside the magic
-                data = data[idx + 2:]
-            for i, c in enumerate(chunks):
-                if i == 0:
-                    cflag = 1
-                elif i == len(chunks) - 1:
-                    cflag = 3
-                else:
-                    cflag = 2
-                self._write_chunk(cflag, c)
+            return
+        begin = 0
+        for n, i in enumerate(splits):
+            self._write_chunk(1 if n == 0 else 2, buf[begin:i])
+            begin = i + 4
+        self._write_chunk(3, buf[begin:])
 
     def _write_chunk(self, cflag, data):
         # each chunk stores its OWN payload length (dmlc framing)
@@ -137,14 +146,19 @@ class MXRecordIO:
             self.handle.write(b"\x00" * pad)
 
     def read(self):
-        """Read one record; None at EOF."""
+        """Read one record; None at EOF.
+
+        Re-inserts the excised kMagic before every continuation (cflag
+        2/3) chunk — dmlc-core RecordIOReader::NextRecord semantics.
+        """
         assert not self.writable
         self._check_pid(allow_reset=True)
-        out = b""
+        out = None
+        magic_bytes = struct.pack("<I", _MAGIC)
         while True:
             header = self.handle.read(8)
             if len(header) < 8:
-                if out:
+                if out is not None:
                     raise MXNetError(f"truncated RecordIO file {self.uri}")
                 return None
             magic, lrec = struct.unpack("<II", header)
@@ -155,7 +169,14 @@ class MXRecordIO:
             self._skip_pad(length)
             if cflag == 0:
                 return data
-            out += data
+            if cflag == 1:
+                out = data
+            elif out is None:
+                raise MXNetError(
+                    f"RecordIO continuation chunk without start in "
+                    f"{self.uri}")
+            else:
+                out += magic_bytes + data
             if cflag == 3:
                 return out
 

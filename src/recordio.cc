@@ -7,8 +7,10 @@
 //
 // Byte-compatible framing with mxnet_tpu/recordio.py:
 //   [kMagic u32][cflag(3b)|len(29b) u32][payload][pad to 4]
-// cflag: 0 whole, 1 start, 2 middle, 3 end (records containing the magic
-// are split so no payload chunk embeds a full magic header).
+// cflag: 0 whole, 1 start, 2 middle, 3 end.  dmlc-core split semantics:
+// the writer scans only 4-byte-ALIGNED positions for embedded magics,
+// EXCISES each from the payload (the chunk boundary stands in for it),
+// and the reader re-inserts kMagic before every cflag-2/3 chunk.
 //
 // Exposed as a flat C API (ctypes-loadable; reference: the c_api layer
 // design, include/mxnet/c_api.h).  Build: `make -C src` → libmxtpu_io.so.
@@ -62,6 +64,10 @@ bool ReadRecordAt(FILE* fp, int64_t offset, std::string* out,
     }
     uint32_t cflag = header[1] >> 29;
     uint32_t len = header[1] & kLenMask;
+    if (cflag == 2 || cflag == 3) {
+      // re-insert the excised embedded magic (dmlc-core NextRecord)
+      out->append(reinterpret_cast<const char*>(&kMagic), 4);
+    }
     size_t cur = out->size();
     out->resize(cur + len);
     if (len && std::fread(&(*out)[cur], 1, len, fp) != len) {
@@ -157,37 +163,24 @@ void* mxtpu_recio_open_write(const char* path, int append) {
 int64_t mxtpu_recio_write(void* h, const char* data, int64_t len) {
   auto* w = static_cast<Writer*>(h);
   int64_t pos = std::ftell(w->fp);
-  // split on embedded magics so no chunk payload contains the header
+  // dmlc-core WriteRecord: scan only 4-byte-aligned positions; each
+  // aligned embedded magic is excised (chunk boundary stands in for it)
   const char* magic_bytes = reinterpret_cast<const char*>(&kMagic);
-  std::vector<std::pair<const char*, uint32_t>> chunks;
-  const char* cur = data;
-  int64_t remaining = len;
-  while (true) {
-    const char* found = nullptr;
-    if (remaining >= 4) {
-      for (const char* p = cur; p + 4 <= cur + remaining; ++p) {
-        if (std::memcmp(p, magic_bytes, 4) == 0) {
-          found = p;
-          break;
-        }
-      }
-    }
-    if (!found) {
-      chunks.emplace_back(cur, static_cast<uint32_t>(remaining));
-      break;
-    }
-    uint32_t take = static_cast<uint32_t>(found - cur) + 2;  // split magic
-    chunks.emplace_back(cur, take);
-    cur += take;
-    remaining -= take;
+  std::vector<int64_t> splits;
+  int64_t lower_align = len & ~static_cast<int64_t>(3);
+  for (int64_t i = 0; i < lower_align; i += 4) {
+    if (std::memcmp(data + i, magic_bytes, 4) == 0) splits.push_back(i);
   }
-  if (chunks.size() == 1) {
-    WriteChunk(w->fp, 0, chunks[0].first, chunks[0].second);
+  if (splits.empty()) {
+    WriteChunk(w->fp, 0, data, static_cast<uint32_t>(len));
   } else {
-    for (size_t i = 0; i < chunks.size(); ++i) {
-      uint32_t cflag = i == 0 ? 1 : (i + 1 == chunks.size() ? 3 : 2);
-      WriteChunk(w->fp, cflag, chunks[i].first, chunks[i].second);
+    int64_t begin = 0;
+    for (size_t n = 0; n < splits.size(); ++n) {
+      WriteChunk(w->fp, n == 0 ? 1u : 2u, data + begin,
+                 static_cast<uint32_t>(splits[n] - begin));
+      begin = splits[n] + 4;
     }
+    WriteChunk(w->fp, 3, data + begin, static_cast<uint32_t>(len - begin));
   }
   w->idx.push_back(pos);
   return pos;

@@ -107,6 +107,50 @@ def test_recordio_magic_embedded(tmp_path):
     assert r.read() == payload
 
 
+def test_recordio_magic_torture(tmp_path):
+    """dmlc-core split semantics: aligned embedded magics are excised on
+    write and re-inserted on read; unaligned ones pass through.  Python
+    and C++ codecs must produce byte-identical files (reference:
+    3rdparty/dmlc-core/src/recordio.cc WriteRecord/NextRecord)."""
+    import struct
+
+    magic = struct.pack("<I", 0xced7230a)
+    recs = [
+        b"hello world",
+        magic,                      # record that IS a magic
+        b"ab" + magic + b"cd",      # unaligned magic (kept inline)
+        b"abcd" + magic + b"efgh",  # aligned magic (excised)
+        magic + magic + b"tail",    # consecutive aligned magics
+        b"xyz1" + magic,            # aligned magic at end
+        b"",
+        bytes(range(256)) * 4 + magic * 3,
+    ]
+    path = str(tmp_path / "torture.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for rec in recs:
+        w.write(rec)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for expected in recs:
+        assert r.read() == expected
+    assert r.read() is None
+
+    from mxnet_tpu import _native
+
+    if _native.available():
+        nr = _native.NativeRecordReader(path)
+        offs = nr.scan()
+        assert [nr.read_at(o) for o in offs] == recs
+        nr.close()
+        cc_path = str(tmp_path / "torture_cc.rec")
+        nw = _native.NativeRecordWriter(cc_path)
+        for rec in recs:
+            nw.write(rec)
+        nw.close()
+        with open(path, "rb") as f1, open(cc_path, "rb") as f2:
+            assert f1.read() == f2.read()
+
+
 def test_indexed_recordio(tmp_path):
     rec = str(tmp_path / "test.rec")
     idx = str(tmp_path / "test.idx")
