@@ -15,10 +15,18 @@ from .base import MXNetError, _ThreadLocalStack
 
 @functools.lru_cache(maxsize=None)
 def _jax_devices(platform: str | None = None):
+    """Devices a Context may resolve to: LOCAL (addressable) only.  In a
+    multi-process run jax.devices() spans all hosts; ctx cpu(0)/tpu(0)
+    must mean THIS process's device 0 (reference: device ids are
+    process-local)."""
     import jax
 
     try:
-        return tuple(jax.devices(platform) if platform else jax.devices())
+        devs = tuple(jax.devices(platform)) if platform \
+            else tuple(jax.devices())
+        local = tuple(d for d in devs
+                      if d.process_index == jax.process_index())
+        return local or devs
     except RuntimeError:
         return ()
 

@@ -455,6 +455,12 @@ class HybridBlock(Block):
                 _TRACE.param_map, _TRACE.aux_collector = prev_map, prev_aux
             return out, aux
 
+        # hybridize(remat=...) / MXNET_BACKWARD_DO_MIRROR: backward
+        # recomputes activations (reference mirror pass; remat.py)
+        from .. import remat as _remat
+
+        pure_step = _remat.wrap(pure_step,
+                                dict(self._flags).get("remat"))
         fn = jax.jit(pure_step)
         self._jit_fns[cache_key] = fn
         return fn

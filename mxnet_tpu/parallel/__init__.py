@@ -21,6 +21,7 @@ from .sharding import ShardingRules, TRANSFORMER_TP_RULES, annotate_block
 from . import ring
 from .ring import ring_attention, ulysses_attention
 from . import pipeline
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (PipelineTrainer, pipeline_apply,
+                       stack_stage_params)
 from . import trainer
 from .trainer import DataParallelTrainer, ShardedTrainer

@@ -56,4 +56,6 @@ def pvary(x, axis_names):
         pass
     if hasattr(lax, "pcast"):
         return lax.pcast(x, tuple(axis_names), to="varying")
-    return lax.pvary(x, tuple(axis_names))
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, tuple(axis_names))
+    return x  # pre-vma jax: no varying marks needed

@@ -1,4 +1,4 @@
-"""Pipeline parallelism.
+"""Pipeline parallelism — forward AND training.
 
 Reference parity: the reference's only model-parallel mechanism is
 ``group2ctx`` device placement (SURVEY.md §2.5 — nnvm PlaceDevice pass +
@@ -12,6 +12,13 @@ arrays sharded on pp); the schedule runs num_micro + num_stages - 1 ticks;
 at each tick every device runs its stage on the activation it holds, then
 ppermutes activations forward one stage.  This is the standard SPMD
 "collective pipeline" formulation — no per-stage programs, one XLA module.
+
+The schedule is written as a ``lax.scan``, so reverse-mode AD *derives*
+the backward pipeline (activations ride the scan's saved residuals, the
+ppermute transposes to the reverse neighbor push) — the GPipe backward
+schedule falls out of the forward program instead of being hand-built.
+``PipelineTrainer`` stacks a homogeneous Gluon stage list on the pp axis
+and compiles forward + backward + optimizer into one XLA program.
 """
 
 from __future__ import annotations
@@ -20,8 +27,40 @@ from ..base import MXNetError
 from .mesh import PP, default_mesh
 
 
+def _pipeline_outs(stage_fn, n_stages, n_micro, axis, params, xs):
+    """shard_map-local differentiable schedule.  params leaves: (1, ...)
+    = this device's stage slice; xs: (n_micro, mb, ...) replicated.
+    Returns (n_micro, mb, ...) last-stage outputs (replicated)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ._compat import pvary
+
+    my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+    stage = lax.axis_index(axis)
+    n_ticks = n_micro + n_stages - 1
+    fwd_perm = [(r, (r + 1) % n_stages) for r in range(n_stages)]
+    carry0 = pvary(jnp.zeros(xs.shape[1:], xs.dtype), (axis,))
+    xs = pvary(xs, (axis,))
+
+    def tick(carry, t):
+        feed_idx = jnp.clip(t, 0, n_micro - 1)
+        my_in = jnp.where(stage == 0, xs[feed_idx], carry)
+        y = stage_fn(my_params, my_in)
+        return lax.ppermute(y, axis, fwd_perm), y
+
+    _, ys = lax.scan(tick, carry0, jnp.arange(n_ticks))
+    # microbatch m leaves the last stage at tick m + n_stages - 1
+    outs = ys[n_stages - 1:]
+    # only the last stage holds real outputs; broadcast to all
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+        axis)
+
+
 def pipeline_apply(stage_fn, params_stacked, x_micro, mesh=None, axis=PP):
-    """Run a pipelined forward.
+    """Run a pipelined forward (differentiable).
 
     stage_fn(stage_params, x) -> y : the per-stage computation (all stages
     must share one signature/shape — the usual homogeneous-transformer
@@ -32,10 +71,9 @@ def pipeline_apply(stage_fn, params_stacked, x_micro, mesh=None, axis=PP):
     Returns (n_micro, mb, ...) outputs from the LAST stage (replicated).
     """
     import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from ._compat import shard_map
     from jax.sharding import PartitionSpec
+
+    from ._compat import shard_map
 
     mesh = mesh or default_mesh()
     if mesh is None:
@@ -52,45 +90,11 @@ def pipeline_apply(stage_fn, params_stacked, x_micro, mesh=None, axis=PP):
     xspec = PartitionSpec()
 
     def local(params, xs):
-        # params leaves: (1, ...) — this device's stage slice
-        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
-        stage = lax.axis_index(axis)
-        n_ticks = n_micro + n_stages - 1
-        mb_shape = xs.shape[1:]
-        out_shape = jax.eval_shape(
-            lambda p, x: stage_fn(p, x), my_params,
-            jax.ShapeDtypeStruct(mb_shape, xs.dtype))
-        carry_in = jnp.zeros(mb_shape, xs.dtype)
-        outs = jnp.zeros((n_micro,) + tuple(out_shape.shape),
-                         out_shape.dtype)
-        fwd_perm = [(r, (r + 1) % n_stages) for r in range(n_stages)]
-
-        def tick(t, state):
-            carry, outs = state
-            # stage 0 ingests microbatch t (when in range)
-            feed_idx = jnp.clip(t, 0, n_micro - 1)
-            my_in = jnp.where(stage == 0, xs[feed_idx], carry)
-            y = stage_fn(my_params, my_in)
-            # last stage emits microbatch (t - n_stages + 1)
-            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-            emit = jnp.logical_and(stage == n_stages - 1,
-                                   t >= n_stages - 1)
-            outs = lax.cond(
-                emit,
-                lambda o: o.at[out_idx].set(y.astype(outs.dtype)),
-                lambda o: o, outs)
-            carry = lax.ppermute(y, axis, fwd_perm)
-            return carry, outs
-
-        _, outs = lax.fori_loop(0, n_ticks, tick, (carry_in, outs))
-        # the last stage holds the real outputs; broadcast to all
-        outs = lax.psum(
-            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
-            axis)
-        return outs
+        return _pipeline_outs(stage_fn, n_stages, n_micro, axis, params,
+                              xs)
 
     fn = shard_map(local, mesh=mesh, in_specs=(pspec, xspec),
-                   out_specs=xspec, check_rep=False)
+                   out_specs=xspec)
     return fn(params_stacked, x_micro)
 
 
@@ -102,3 +106,243 @@ def stack_stage_params(per_stage_params):
 
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+class PipelineTrainer:
+    """GPipe training of a homogeneous stage list as ONE XLA program.
+
+    The model is a list of structurally-identical Gluon blocks (or a
+    (Hybrid)Sequential whose children divide evenly into such groups):
+    transformer layers, the Dense towers of the reference's
+    model-parallel-lstm example, etc.  Per-stage parameters are stacked
+    (leading dim = n_stages) and sharded on the mesh ``pp`` axis, so each
+    device holds exactly its stage; forward runs the scan schedule above,
+    backward is its AD transpose (the reverse pipeline), and the
+    optimizer updates each stage's shard in place — all in one jit with
+    donated buffers.
+
+    v1 limits (documented, reference has no pipeline at all): stages must
+    be aux-free (no BatchNorm running stats) and share one input/output
+    shape; the loss attaches to the last stage's output.
+    """
+
+    def __init__(self, stages, loss_fn, optimizer="sgd",
+                 optimizer_params=None, mesh=None, n_microbatches=None,
+                 axis=PP):
+        import jax
+
+        from .trainer import _PureOptimizer
+
+        mesh = mesh or default_mesh()
+        if mesh is None:
+            raise MXNetError("PipelineTrainer needs a mesh")
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = mesh.shape.get(axis, 1)
+        self.loss_fn = loss_fn
+        self.stages = self._as_stages(stages)
+        self.n_micro = int(n_microbatches or self.n_stages)
+        if self.n_micro < self.n_stages:
+            raise MXNetError("n_microbatches must be >= n_stages")
+        opt_kwargs = dict(optimizer_params or {})
+        lr = opt_kwargs.pop("learning_rate", opt_kwargs.pop("lr", 0.01))
+        self.optimizer = _PureOptimizer(optimizer, lr=lr, **opt_kwargs)
+        self._num_update = 0
+        self._initialized = False
+        self._step_fn = None
+
+    def _as_stages(self, stages):
+        if isinstance(stages, (list, tuple)):
+            stage_list = list(stages)
+        else:  # a Sequential-like block
+            children = list(stages._children.values())
+            if not children or len(children) % self.n_stages:
+                raise MXNetError(
+                    f"cannot split {len(children)} layers into "
+                    f"{self.n_stages} equal pipeline stages")
+            per = len(children) // self.n_stages
+            if per == 1:
+                stage_list = children
+            else:
+                from ..gluon.nn import HybridSequential
+
+                stage_list = []
+                for s in range(self.n_stages):
+                    seq = HybridSequential(prefix=f"ppstage{s}_")
+                    for c in children[s * per:(s + 1) * per]:
+                        seq.add(c)
+                    stage_list.append(seq)
+        if len(stage_list) != self.n_stages:
+            raise MXNetError(
+                f"got {len(stage_list)} stages for a {self.n_stages}-way "
+                f"pp mesh")
+        return stage_list
+
+    # -- staging ---------------------------------------------------------------
+
+    def _stage_params(self, example):
+        """Materialize deferred shapes, stack per-stage params on pp."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .. import autograd as _ag
+        from ..gluon.block import _TRACE
+
+        # resolve deferred init by running each stage once, chained
+        prev = _TRACE.force_eager
+        _TRACE.force_eager = True
+        try:
+            with _ag.pause():
+                h = example
+                for s in self.stages:
+                    h = s(h)
+        finally:
+            _TRACE.force_eager = prev
+
+        # structural (registration) order, NOT name sort: lexicographic
+        # names permute across stages once indices hit two digits
+        # (dense9 > dense10), mis-pairing weights between stages
+        per_stage = []
+        for s in self.stages:
+            items = list(s.collect_params().items())
+            bad = [n for n, p in items if p.grad_req == "null"]
+            if bad:
+                raise MXNetError(
+                    f"PipelineTrainer: aux params unsupported in v1 "
+                    f"(stage has {bad})")
+            per_stage.append([p.data()._data for _, p in items])
+        shapes = [[tuple(a.shape) for a in vals] for vals in per_stage]
+        if any(sh != shapes[0] for sh in shapes[1:]):
+            raise MXNetError(
+                f"pipeline stages are not structurally identical: "
+                f"{shapes}")
+        # template ids come from stage 0; its forward executes every stage
+        self._template = self.stages[0]
+        self._template_ids = [id(p) for _, p in
+                              self._template.collect_params().items()]
+        stacked = [jnp.stack([vals[j] for vals in per_stage])
+                   for j in range(len(per_stage[0]))]
+        self._pspec = NamedSharding(self.mesh, PartitionSpec(self.axis))
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
+        self._param_vals = [jax.device_put(a, self._pspec)
+                            for a in stacked]
+        self._opt_state = [
+            tuple(jax.device_put(s, self._pspec) for s in states)
+            for states in self.optimizer.init_state(self._param_vals)]
+        tmpl = list(self._template.collect_params().items())
+        self._wd_mults = [p.wd_mult for _, p in tmpl]
+        self._lr_mults = [p.lr_mult for _, p in tmpl]
+        self._initialized = True
+
+    def _build_step(self, batch_shape):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import autograd as _ag
+        from .. import random as _random
+        from ..gluon.block import _TRACE
+
+        template = self._template
+        t_ids = list(self._template_ids)
+        loss_block = self.loss_fn
+        optimizer = self.optimizer
+        n_stages, n_micro, axis = self.n_stages, self.n_micro, self.axis
+        mesh = self.mesh
+        wd_mults = tuple(self._wd_mults)
+        lr_mults = tuple(self._lr_mults)
+
+        from jax.sharding import PartitionSpec
+
+        from ._compat import shard_map
+
+        def stage_fn(stage_vals, x):
+            pm = dict(zip(t_ids, stage_vals))
+            prev_map = _TRACE.param_map
+            _TRACE.param_map = pm
+            try:
+                with _ag.train_mode():
+                    return template.forward(x)
+            finally:
+                _TRACE.param_map = prev_map
+
+        pspec_tree = [PartitionSpec(axis) for _ in self._param_vals]
+
+        def fwd_micro(param_vals, xs):
+            local = lambda params, xs_: _pipeline_outs(
+                stage_fn, n_stages, n_micro, axis, params, xs_)
+            fn = shard_map(local, mesh=mesh,
+                           in_specs=(pspec_tree, PartitionSpec()),
+                           out_specs=PartitionSpec())
+            return fn(param_vals, xs)
+
+        def pure_step(param_vals, opt_state, x, y, key, lr, t):
+            def loss_of(pv):
+                xs = x.reshape((n_micro, -1) + x.shape[1:])
+                with _random.key_scope(key):
+                    outs = fwd_micro(pv, xs)
+                    outs = outs.reshape((-1,) + outs.shape[2:])
+                    loss = loss_block(outs, y) \
+                        if loss_block is not None else outs
+                return jnp.mean(loss)
+
+            loss, grads = jax.value_and_grad(loss_of)(param_vals)
+            new_p, new_s = optimizer.apply(
+                param_vals, grads, opt_state, lr, t, wd_mults, lr_mults,
+                1.0)
+            return new_p, new_s, loss
+
+        with self.mesh:
+            self._step_fn = jax.jit(
+                pure_step,
+                in_shardings=(
+                    [self._pspec] * len(self._param_vals),
+                    [tuple(self._pspec for _ in st)
+                     for st in self._opt_state],
+                    self._repl, self._repl, None, None, None),
+                out_shardings=(
+                    [self._pspec] * len(self._param_vals),
+                    [tuple(self._pspec for _ in st)
+                     for st in self._opt_state],
+                    self._repl),
+                donate_argnums=(0, 1))
+
+    # -- public API ------------------------------------------------------------
+
+    def step(self, data, label):
+        """One pipelined training step; batch dim 0 must divide into
+        n_microbatches."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray, _from_jax
+
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        y = label._data if isinstance(label, NDArray) \
+            else jnp.asarray(label)
+        if x.shape[0] % self.n_micro:
+            raise MXNetError(
+                f"batch {x.shape[0]} not divisible by n_microbatches "
+                f"{self.n_micro}")
+        if not self._initialized:
+            mb = x.shape[0] // self.n_micro
+            self._stage_params(_from_jax(x[:mb]))
+            self._build_step(x.shape)
+        x = jax.device_put(x, self._repl)
+        y = jax.device_put(y, self._repl)
+        self._num_update += 1
+        t = self._num_update
+        lr = self.optimizer.lr_at(t)
+        key = _random.next_key()
+        self._param_vals, self._opt_state, loss = self._step_fn(
+            self._param_vals, self._opt_state, x, y, key,
+            jnp.asarray(lr, jnp.float32), jnp.asarray(t, jnp.float32))
+        return _from_jax(loss)
+
+    def sync_params(self):
+        """Write stage slices back into the Gluon Parameters."""
+        for j, stacked in enumerate(self._param_vals):
+            for s, stage in enumerate(self.stages):
+                items = list(stage.collect_params().items())
+                items[j][1].data()._set_data(stacked[s])

@@ -186,13 +186,14 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd",
                  optimizer_params=None, mesh=None, rules=None,
-                 batch_axis=DP, grad_accum=1):
+                 batch_axis=DP, grad_accum=1, remat=None):
         import jax
 
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.batch_axis = batch_axis
+        self.remat = remat
         opt_kwargs = dict(optimizer_params or {})
         lr = opt_kwargs.pop("learning_rate", opt_kwargs.pop("lr", 0.01))
         self.optimizer = _PureOptimizer(optimizer, lr=lr, **opt_kwargs)
@@ -284,6 +285,12 @@ class ShardedTrainer:
                     _TRACE.param_map = prev_map
                     _TRACE.aux_collector = prev_aux
                 return jnp.mean(loss), aux_upd
+
+            # remat='full'|'dots'|... or MXNET_BACKWARD_DO_MIRROR: the
+            # backward recomputes activations (reference mirror pass)
+            from .. import remat as _remat
+
+            loss_of = _remat.wrap(loss_of, self.remat)
 
             if grad_accum == 1:
                 (loss, aux_upd), grads = jax.value_and_grad(

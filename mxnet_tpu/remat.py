@@ -1,0 +1,57 @@
+"""Memory-saving recompute (rematerialization).
+
+Reference parity: the gradient-mirroring pass enabled by
+``MXNET_BACKWARD_DO_MIRROR`` (SURVEY.md §2.5 memory-saving recompute —
+nnvm Gradient pass mirror_fun).  TPU-first, this is ``jax.checkpoint``:
+the backward pass recomputes activations instead of saving them, trading
+FLOPs for HBM.
+
+Knobs (either works):
+- ``net.hybridize(remat='full'|'dots'|'dots_no_batch')``
+- ``parallel.ShardedTrainer(..., remat=...)``
+- env ``MXNET_BACKWARD_DO_MIRROR=1`` → default policy 'full' wherever no
+  explicit remat argument was given (the reference's env semantics).
+
+Policies:
+- 'full'  (or True): save nothing — recompute the whole forward in the
+  backward pass (maximum memory saving, one extra forward of FLOPs).
+- 'dots': save MXU results (matmul/conv outputs), recompute the
+  cheap elementwise chains — the usual sweet spot on TPU, where HBM
+  bandwidth, not FLOPs, is the constraint.
+- 'dots_no_batch': like 'dots' but excludes batch-dim dots.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+
+def env_default(remat):
+    """Apply the MXNET_BACKWARD_DO_MIRROR env default when unset."""
+    if remat is None and os.environ.get("MXNET_BACKWARD_DO_MIRROR",
+                                        "0") not in ("0", ""):
+        return "full"
+    return remat
+
+
+def wrap(fn, remat):
+    """Wrap a traceable function in jax.checkpoint per the policy name
+    (None → unchanged)."""
+    remat = env_default(remat)
+    if not remat:
+        return fn
+    import jax
+
+    if remat is True or remat == "full":
+        policy = None  # save nothing
+    elif remat == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+    elif remat == "dots_no_batch":
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    else:
+        raise MXNetError(
+            f"unknown remat policy {remat!r}: use 'full', 'dots', or "
+            f"'dots_no_batch'")
+    return jax.checkpoint(fn, policy=policy)
