@@ -22,6 +22,8 @@ from . import rnn as rnn_op
 from . import attention
 from . import contrib_det
 from . import quantization
+from . import vision_extra
+from . import legacy_output
 
 # Re-export every registered pure function at module level so that
 # `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.  A

@@ -162,3 +162,11 @@ def smooth_l1(data, scalar=1.0):
     absd = jnp.abs(data)
     return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data,
                      absd - 0.5 / s2)
+
+
+@register("digamma")
+def digamma(data):
+    """Reference: mshadow_op digamma (unary_op_gamma)."""
+    import jax
+
+    return jax.scipy.special.digamma(data)

@@ -134,3 +134,25 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
     out = jnp.take_along_axis(
         moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+@register("unravel_index")
+def unravel_index(data, shape=None):
+    """Flat index → multi-index rows (reference: tensor/ravel.cc)."""
+    idx = jnp.stack(jnp.unravel_index(data.astype(jnp.int32),
+                                      tuple(int(s) for s in shape)))
+    return idx.astype(data.dtype)
+
+
+@register("ravel_multi_index")
+def ravel_multi_index(data, shape=None):
+    """Multi-index rows (N, ...) → flat indices (reference: ravel.cc)."""
+    shape = tuple(int(s) for s in shape)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides.reshape((-1,) + (1,) * (data.ndim - 1)),
+                   axis=0)

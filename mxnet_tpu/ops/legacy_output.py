@@ -1,0 +1,140 @@
+"""Legacy output/loss-head ops.
+
+Reference parity: src/operator/regression_output.cc
+(LinearRegressionOutput, LogisticRegressionOutput, MAERegressionOutput),
+svm_output.cc (SVMOutput), make_loss.cc (MakeLoss), and the AMP helpers
+all_finite/multi_all_finite (contrib/all_finite.cc, ≥1.5).
+
+These ops have *asymmetric* forward/backward semantics — the forward is
+(near-)identity while the backward injects the loss gradient — so each is
+a ``jax.custom_vjp`` (the reference registers explicit backward kernels
+for the same reason).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _head(fwd_fn, grad_fn):
+    """Build an output head: forward = fwd_fn(data), d(data) =
+    grad_fn(data, label) * grad_scale / batch, d(label) = 0."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        return fwd_fn(data), (data, label)
+
+    def bwd(grad_scale, res, g):
+        data, label = res
+        scale = grad_scale / data.shape[0]
+        # the reference ignores the incoming head gradient (treats the
+        # output as the loss terminal); match that but keep g's dtype
+        dd = (grad_fn(data, label) * scale).astype(data.dtype)
+        return dd, jnp.zeros_like(label)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+_linreg = _head(lambda d: d, lambda d, l: d - l.reshape(d.shape))
+_maereg = _head(lambda d: d, lambda d, l: jnp.sign(d - l.reshape(d.shape)))
+_logreg = _head(jax.nn.sigmoid,
+                lambda d, l: jax.nn.sigmoid(d) - l.reshape(d.shape))
+
+
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0):
+    """Identity forward; backward injects (pred - label)
+    (reference: regression_output.cc)."""
+    return _linreg(data, label, float(grad_scale))
+
+
+@register("MAERegressionOutput", aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _maereg(data, label, float(grad_scale))
+
+
+@register("LogisticRegressionOutput",
+          aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _logreg(data, label, float(grad_scale))
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward = identity; backward is the (squared) hinge-loss gradient
+    (reference: svm_output.cc)."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def op(data, label, margin, reg, linear):
+        return data
+
+    def fwd(data, label, margin, reg, linear):
+        return data, (data, label)
+
+    def bwd(margin, reg, linear, res, g):
+        data, label = res
+        n_class = data.shape[1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), n_class,
+                                dtype=data.dtype)
+        score_y = jnp.sum(data * onehot, axis=1, keepdims=True)
+        viol = margin - (score_y - data)          # margin violation
+        active = (viol > 0) & (onehot == 0)
+        if linear:
+            dwrong = jnp.where(active, reg, 0.0)
+        else:
+            dwrong = jnp.where(active, 2.0 * viol * reg, 0.0)
+        dright = -jnp.sum(dwrong, axis=1, keepdims=True) * onehot
+        return (dwrong + dright).astype(data.dtype), \
+            jnp.zeros_like(label)
+
+    op.defvjp(fwd, bwd)
+    return op(data, label, float(margin),
+              float(regularization_coefficient), bool(use_linear))
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null"):
+    """Marks a symbol as a loss terminal: forward = identity, backward =
+    grad_scale (reference: make_loss.cc)."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def op(data, scale):
+        return data
+
+    def fwd(data, scale):
+        return data, data.shape
+
+    def bwd(scale, shape, g):
+        return (jnp.full(shape, scale),)
+
+    op.defvjp(fwd, bwd)
+    scale = float(grad_scale)
+    if normalization == "batch":
+        scale = scale  # resolved against shape in bwd via full
+    return op(data, scale)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    """1.0 if every element is finite (reference: contrib/all_finite.cc;
+    the AMP loss-scaling overflow check)."""
+    return jnp.all(jnp.isfinite(data)).astype(jnp.float32)
+
+
+@register("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a))
+    return ok.astype(jnp.float32)

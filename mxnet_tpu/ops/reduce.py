@@ -6,6 +6,7 @@ ordering_op.cc (topk/sort/argsort), src/operator/tensor/matrix_op (norm).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -92,7 +93,12 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
     if ret_typ == "both":
         return vals, idx
     if ret_typ == "mask":
-        raise NotImplementedError("topk ret_typ='mask'")
+        # 1 at the top-k positions, 0 elsewhere (reference: ordering_op
+        # ret_typ=mask)
+        k_idx = jnp.moveaxis(idx, axis, -1).astype(jnp.int32)
+        mask = jnp.sum(jax.nn.one_hot(k_idx, x.shape[-1],
+                                      dtype=data.dtype), axis=-2)
+        return jnp.moveaxis(mask, -1, axis)
     return idx
 
 

@@ -1,0 +1,396 @@
+"""Vision ops beyond the basic conv/pool set.
+
+Reference parity: src/operator/ — LRN (lrn.cc), BilinearSampler
+(bilinear_sampler.cc), GridGenerator (grid_generator.cc),
+SpatialTransformer (spatial_transformer.cc), Crop (crop.cc), Correlation
+(correlation.cc), and src/operator/contrib/ — Proposal (proposal.cc),
+MultiProposal (multi_proposal.cc), DeformableConvolution
+(deformable_convolution.cc), PSROIPooling (psroi_pooling.cc).
+
+TPU-first: all static-shape jnp programs.  The samplers express bilinear
+gather as vectorized take + lerp (XLA fuses the gathers); deformable conv
+builds sampled im2col columns and runs ONE MXU matmul; Proposal keeps the
+reference's padded fixed-length output contract (SURVEY.md §7
+dynamic-shape strategy) so it jits with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# -- LRN -----------------------------------------------------------------------
+
+@register("LRN", aliases=("lrn",))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (reference: lrn.cc;
+    AlexNet-era).  out = x / (knorm + alpha/n * sum_local x^2)^beta."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    # sum over a window of channels via padded cumsum difference
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    padded = jnp.pad(sq, pad)
+    csum = jnp.cumsum(padded, axis=1)
+    zero = jnp.zeros_like(csum[:, :1])
+    csum = jnp.concatenate([zero, csum], axis=1)
+    C = data.shape[1]
+    local = csum[:, nsize:nsize + C] - csum[:, :C]
+    return data * jnp.power(knorm + (alpha / nsize) * local, -beta)
+
+
+# -- bilinear sampling family --------------------------------------------------
+
+def _bilinear_gather(data, gx, gy):
+    """Sample NCHW `data` at fractional pixel coords (B, Ho, Wo) with
+    zero padding outside; returns (B, C, Ho, Wo)."""
+    B, C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    dx = gx - x0
+    dy = gy - y0
+
+    def tap(xi, yi):
+        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0)
+               & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        flat = data.reshape(B, C, H * W)
+        lin = (yc * W + xc).reshape(B, -1)  # (B, Ho*Wo)
+        vals = jnp.take_along_axis(flat, lin[:, None, :], axis=2)
+        vals = vals.reshape(B, C, *xi.shape[1:])
+        return vals * inb[:, None].astype(data.dtype)
+
+    w00 = ((1 - dx) * (1 - dy))[:, None]
+    w01 = (dx * (1 - dy))[:, None]
+    w10 = ((1 - dx) * dy)[:, None]
+    w11 = (dx * dy)[:, None]
+    return (tap(x0, y0) * w00 + tap(x0 + 1, y0) * w01
+            + tap(x0, y0 + 1) * w10 + tap(x0 + 1, y0 + 1) * w11)
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """Sample `data` (B,C,H,W) at `grid` (B,2,Ho,Wo) of normalized
+    [-1,1] (x, y) coords (reference: bilinear_sampler.cc)."""
+    B, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return _bilinear_gather(data, gx, gy)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Generate a sampling grid (reference: grid_generator.cc).
+
+    affine: data (B, 6) row-major 2x3 -> grid (B, 2, H, W).
+    warp: data (B, 2, H, W) flow field -> grid of (x+fx, y+fy) normalized.
+    """
+    if transform_type == "affine":
+        B = data.shape[0]
+        H, W = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(B, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones]).reshape(3, H * W)  # (3, HW)
+        out = jnp.einsum("bij,jk->bik", theta, base)  # (B, 2, HW)
+        return out.reshape(B, 2, H, W)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        ys = jnp.arange(H, dtype=data.dtype)
+        xs = jnp.arange(W, dtype=data.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        x = (data[:, 0] + gx) * 2.0 / max(W - 1, 1) - 1.0
+        y = (data[:, 1] + gy) * 2.0 / max(H - 1, 1) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type}")
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """Affine spatial transformer = GridGenerator + BilinearSampler
+    (reference: spatial_transformer.cc, Jaderberg et al. 2015)."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("Crop", aliases=("crop",))
+def crop_op(data, *like, offset=(0, 0), h_w=(0, 0), num_args=None,
+            center_crop=False):
+    """Legacy Crop (reference: crop.cc): crop NCHW `data` to `h_w` (or to
+    the spatial shape of a second input) at `offset` / centered."""
+    H, W = data.shape[2], data.shape[3]
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = int(h_w[0]) or H, int(h_w[1]) or W
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return lax.dynamic_slice(
+        data, (0, 0, oy, ox),
+        (data.shape[0], data.shape[1], th, tw))
+
+
+# -- Correlation (FlowNet) -----------------------------------------------------
+
+@register("Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Correlation layer (reference: correlation.cc, FlowNet).  Output
+    channel (i,j) is the patch dot-product of data1 with data2 shifted by
+    displacement (dy, dx) over the window [-max_d, max_d] step stride2."""
+    B, C, H, W = data1.shape
+    p = int(pad_size)
+    d1 = jnp.pad(data1, [(0, 0), (0, 0), (p, p), (p, p)])
+    d2 = jnp.pad(data2, [(0, 0), (0, 0), (p, p), (p, p)])
+    md, s1, s2 = int(max_displacement), int(stride1), int(stride2)
+    k = int(kernel_size)
+    bk = k // 2
+    disps = range(-md, md + 1, s2)
+    Hp, Wp = H + 2 * p, W + 2 * p
+    # valid output grid (reference: top extents shrink by max_d + bk)
+    y0, x0 = md + bk, md + bk
+    Ho = (Hp - 2 * (md + bk) - 1) // s1 + 1
+    Wo = (Wp - 2 * (md + bk) - 1) // s1 + 1
+    outs = []
+    for dy in disps:
+        for dx in disps:
+            if is_multiply:
+                prod = d1 * jnp.roll(d2, (-dy, -dx), axis=(2, 3))
+            else:
+                prod = jnp.abs(d1 - jnp.roll(d2, (-dy, -dx), axis=(2, 3)))
+            # patch sum over the kernel window then mean over channels
+            if k > 1:
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, 1, k, k), (1, 1, 1, 1),
+                    "SAME")
+            m = jnp.mean(prod, axis=1)  # (B, Hp, Wp)
+            m = lax.slice(m, (0, y0, x0),
+                          (B, y0 + (Ho - 1) * s1 + 1,
+                           x0 + (Wo - 1) * s1 + 1), (1, s1, s1))
+            outs.append(m)
+    return jnp.stack(outs, axis=1)
+
+
+# -- DeformableConvolution -----------------------------------------------------
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution", "deformable_convolution"))
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=1024, layout=None):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc,
+    Dai et al. 2017).  Each kernel tap samples the input at its grid
+    position PLUS a learned per-location offset, via bilinear
+    interpolation; the sampled im2col columns feed one MXU matmul."""
+    B, C, H, W = data.shape
+    O, Cg, kh, kw = weight.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    dh, dw = (dilate, dilate) if isinstance(dilate, int) else dilate
+    ph, pw = (pad, pad) if isinstance(pad, int) else pad
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    ndg = int(num_deformable_group)
+    # base sampling grid per tap: (kh*kw, Ho, Wo)
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    gy0, gx0 = jnp.meshgrid(oy.astype(data.dtype), ox.astype(data.dtype),
+                            indexing="ij")
+    cols = []
+    off = offset.reshape(B, ndg, kh * kw, 2, Ho, Wo)
+    cpg = C // ndg  # channels per deformable group
+    for t in range(kh * kw):
+        ky, kx = divmod(t, kw)
+        group_cols = []
+        for g in range(ndg):
+            gy = gy0[None] + ky * dh + off[:, g, t, 0]
+            gx = gx0[None] + kx * dw + off[:, g, t, 1]
+            sub = data[:, g * cpg:(g + 1) * cpg]
+            group_cols.append(_bilinear_gather(sub, gx, gy))
+        cols.append(jnp.concatenate(group_cols, axis=1))  # (B,C,Ho,Wo)
+    # (B, C*kh*kw, Ho*Wo) im2col with taps ordered (c, ky, kx) like the
+    # reference weight layout (O, C/g, kh, kw)
+    colmat = jnp.stack(cols, axis=2).reshape(B, C * kh * kw, Ho * Wo)
+    wmat = weight.reshape(O, Cg * kh * kw)
+    if num_group == 1:
+        out = jnp.einsum("ok,bkn->bon", wmat, colmat)
+    else:
+        og = O // num_group
+        colg = colmat.reshape(B, num_group, Cg * kh * kw, Ho * Wo)
+        wg = wmat.reshape(num_group, og, Cg * kh * kw)
+        out = jnp.einsum("gok,bgkn->bgon", wg, colg)
+        out = out.reshape(B, O, Ho * Wo)
+    out = out.reshape(B, O, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# -- PSROIPooling --------------------------------------------------------------
+
+@register("_contrib_PSROIPooling", aliases=("psroi_pooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                  pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling (reference: contrib/psroi_pooling.cc,
+    R-FCN).  data: (B, output_dim*g*g, H, W); rois: (R, 5)."""
+    g = int(group_size) or int(pooled_size)
+    P = int(pooled_size)
+    od = int(output_dim)
+    B, CC, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / P, rh / P
+        img = jnp.take(data, b, axis=0)  # (CC, H, W)
+        out = []
+        for py in range(P):
+            for px in range(P):
+                gy, gx = py * g // P, px * g // P
+                # average pool the bin via a fixed 2x2 sample grid
+                sy = y1 + (py + jnp.asarray([0.25, 0.75])[:, None]) * bin_h
+                sx = x1 + (px + jnp.asarray([0.25, 0.75])[None, :]) * bin_w
+                syc = jnp.clip(sy, 0, H - 1)
+                sxc = jnp.clip(sx, 0, W - 1)
+                chan0 = (gy * g + gx) * od
+                sub = lax.dynamic_slice(img, (chan0, 0, 0), (od, H, W))
+                vals = _bilinear_gather(
+                    sub[None],
+                    jnp.broadcast_to(sxc, (2, 2))[None],
+                    jnp.broadcast_to(syc, (2, 2))[None])[0]
+                out.append(jnp.mean(vals, axis=(1, 2)))
+        return jnp.stack(out, -1).reshape(od, P, P)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# -- Proposal (RPN) ------------------------------------------------------------
+
+def _make_anchors(feature_stride, scales, ratios):
+    """Reference anchor generation (proposal.cc GenerateAnchors)."""
+    import numpy as np
+
+    base = np.array([1, 1, feature_stride, feature_stride]) - 1
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = int(round(np.sqrt(size / r)))
+        hs = int(round(ws * r))
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.asarray(anchors, np.float32)
+
+
+@register("_contrib_Proposal",
+          aliases=("Proposal", "proposal", "_contrib_MultiProposal",
+                   "MultiProposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, feature_stride=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), rpn_min_size=16,
+             iou_loss=False, output_score=False):
+    """RPN proposal op (reference: contrib/proposal.cc).  Static-shape:
+    scores are top-k'd to rpn_pre_nms_top_n, greedy NMS marks suppressed
+    boxes, output is padded to exactly rpn_post_nms_top_n rois per image
+    — the reference pads with the first box too."""
+    import numpy as np
+
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = jnp.asarray(_make_anchors(feature_stride, scales, ratios))
+
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y, shift_x = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([shift_x, shift_y, shift_x, shift_y],
+                       axis=-1).reshape(-1, 4)          # (H*W, 4)
+    all_anchors = (anchors[None] + shifts[:, None]).reshape(-1, 4)
+
+    pre_n = min(int(rpn_pre_nms_top_n), A * H * W)
+    post_n = int(rpn_post_nms_top_n)
+
+    def one_image(scores_fg, deltas, info):
+        # scores_fg: (A, H, W); deltas: (4A, H, W); info: (3,) h, w, scale
+        scores = scores_fg.transpose(1, 2, 0).reshape(-1)     # (HWA,)
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        anc = all_anchors.reshape(H * W, A, 4).reshape(-1, 4)
+        # bbox transform (reference: BBoxTransformInv)
+        ws = anc[:, 2] - anc[:, 0] + 1.0
+        hs = anc[:, 3] - anc[:, 1] + 1.0
+        cx = anc[:, 0] + 0.5 * (ws - 1)
+        cy = anc[:, 1] + 0.5 * (hs - 1)
+        ncx = d[:, 0] * ws + cx
+        ncy = d[:, 1] * hs + cy
+        nw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * ws
+        nh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * hs
+        boxes = jnp.stack([ncx - 0.5 * (nw - 1), ncy - 0.5 * (nh - 1),
+                           ncx + 0.5 * (nw - 1), ncy + 0.5 * (nh - 1)],
+                          axis=1)
+        boxes = jnp.clip(boxes,
+                         jnp.zeros((4,)),
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        # min-size filter
+        min_size = rpn_min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        scores = jnp.where(keep, scores, -1.0)
+        top_scores, order = lax.top_k(scores, pre_n)
+        top_boxes = boxes[order]
+        # greedy NMS over the sorted list
+        def body(i, valid):
+            cur = top_boxes[i]
+            iou = _iou_corner(cur, top_boxes)
+            suppress = (iou > threshold) & (jnp.arange(pre_n) > i)
+            return jnp.where(suppress & valid[i], False, valid)
+
+        valid = top_scores > -1.0
+        valid = lax.fori_loop(0, pre_n, body, valid)
+        # compact the survivors to the front (stable sort keeps score
+        # order), truncate/pad to post_n
+        sorted_idx = jnp.argsort(~valid, stable=True)
+        out_boxes = top_boxes[sorted_idx][:post_n]
+        out_scores = top_scores[sorted_idx][:post_n]
+        n_valid = jnp.sum(valid)
+        pad_mask = jnp.arange(post_n) >= n_valid
+        out_boxes = jnp.where(pad_mask[:, None], out_boxes[0], out_boxes)
+        out_scores = jnp.where(pad_mask, out_scores[0], out_scores)
+        return out_boxes, out_scores
+
+    fg = cls_prob[:, A:]  # foreground scores
+    boxes, scores = jax.vmap(one_image)(fg, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), post_n)
+    rois = jnp.concatenate(
+        [batch_idx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+def _iou_corner(box, boxes):
+    tl = jnp.maximum(box[:2], boxes[:, :2])
+    br = jnp.minimum(box[2:4], boxes[:, 2:4])
+    wh = jnp.maximum(br - tl + 1, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    a = (box[2] - box[0] + 1) * (box[3] - box[1] + 1)
+    b = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+    return inter / jnp.maximum(a + b - inter, 1e-12)
