@@ -1,19 +1,28 @@
 #!/usr/bin/env python
 """Benchmark entry point (driver contract: prints ONE JSON line).
 
-Metric: ResNet-50 training throughput in samples/sec/chip (the BASELINE.md
-headline).  The whole training step — forward, backward, SGD+momentum
-update, BatchNorm stat updates — runs as ONE compiled XLA program
-(parallel.ShardedTrainer) in bfloat16 compute on the MXU.
+Headline metric: ResNet-50 training throughput in samples/sec/chip
+(BASELINE.md metric #1).  The same JSON line also carries BERT-base
+pretraining tokens/sec/chip (BASELINE.md metric #2) measured by a second
+worker, plus an in-run matmul calibration so every number can be sanity
+checked against what the chip actually sustains.
 
-Round-2 hardening (VERDICT.md "Next round" #1/#2): the orchestrator
-process never imports jax.  It runs the actual benchmark in a worker
-subprocess with a time budget, falls back to smaller configs and then to
-the CPU backend if TPU init fails or hangs, and ALWAYS prints exactly one
-structured JSON line.  Workers use a persistent XLA compilation cache
-(.jax_cache/) so the driver's run pays no recompile if the repo was
-benched during the round.  An MFU estimate is included (analytic
-FLOPs/sample ÷ device peak).
+Round-3 honesty hardening (VERDICT.md round 2, Weak #1): on this
+environment's axon TPU backend ``jax.block_until_ready()`` does NOT
+synchronize until the process has performed at least one host readback —
+round 2's bench timed dispatch, not compute, and recorded an impossible
+429% MFU.  Every timing loop here therefore:
+
+  1. ends warmup with a forced host readback (``np.asarray``), and
+  2. ends the timed region with a forced host readback of the last
+     output, and
+  3. passes through a sanity gate: if the implied MFU exceeds
+     ``_MFU_GATE`` the measurement is discarded and re-taken with a
+     readback after EVERY step (strictly correct, slightly pessimistic);
+     an impossible number is never printed.
+
+The orchestrator process never imports jax; workers run in subprocesses
+with time budgets and a persistent XLA compile cache (.jax_cache/).
 
 vs_baseline is null: BASELINE.json.published is {} (reference mount was
 empty — see BASELINE.md provenance note).
@@ -37,62 +46,200 @@ _PEAK_FLOPS = [
 # ResNet-50 @224: ~4.09e9 MACs fwd => 8.2e9 FLOPs; training ~= 3x fwd
 _RESNET50_TRAIN_FLOPS_224 = 3.0 * 2 * 4.089e9
 
+# Measurements above this implied MFU are discarded and re-taken with a
+# readback per step.  0.95 not 1.0: anything near peak on a full training
+# step is itself evidence of a sync bug.
+_MFU_GATE = 0.95
+
 
 def _attempts():
     steps = int(os.environ.get("BENCH_STEPS", 20))
     budget = int(os.environ.get("BENCH_BUDGET", 560))
     tpu_attempts = [] if os.environ.get("BENCH_SKIP_TPU") else [
-        (None, {"batch": int(os.environ.get("BENCH_BATCH", 256)),
+        (None, {"model": "resnet50",
+                "batch": int(os.environ.get("BENCH_BATCH", 256)),
                 "image": int(os.environ.get("BENCH_IMAGE", 224)),
                 "steps": steps, "backend": "tpu"}, budget),
-        (None, {"batch": 64, "image": 224, "steps": 10, "backend": "tpu"},
-         min(300, budget)),
+        (None, {"model": "resnet50", "batch": 64, "image": 224,
+                "steps": 10, "backend": "tpu"}, min(300, budget)),
     ]
     return tpu_attempts + [
         ({"JAX_PLATFORMS": "cpu"},
-         {"batch": 8, "image": 32, "steps": 3, "backend": "cpu"}, 240),
+         {"model": "resnet50", "batch": 8, "image": 32, "steps": 3,
+          "backend": "cpu"}, 240),
     ]
+
+
+def _bert_attempts():
+    steps = int(os.environ.get("BENCH_BERT_STEPS", 12))
+    budget = int(os.environ.get("BENCH_BERT_BUDGET", 420))
+    if os.environ.get("BENCH_SKIP_TPU"):
+        return [({"JAX_PLATFORMS": "cpu"},
+                 {"model": "bert", "batch": 2, "seq": 128, "steps": 2,
+                  "backend": "cpu"}, 240)]
+    return [
+        (None, {"model": "bert",
+                "batch": int(os.environ.get("BENCH_BERT_BATCH", 32)),
+                "seq": int(os.environ.get("BENCH_BERT_SEQ", 512)),
+                "steps": steps, "backend": "tpu"}, budget),
+        (None, {"model": "bert", "batch": 8, "seq": 512, "steps": 6,
+                "backend": "tpu"}, min(300, budget)),
+    ]
+
+
+def _run_worker(env_over, cfg, budget, errors):
+    env = dict(os.environ)
+    if env_over is not None:
+        # CPU fallback: strip anything that could claim the tunnel
+        env = {k: v for k, v in env.items()
+               if not k.startswith(_HOSTILE_ENV_PREFIXES)}
+        env.update(env_over)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             json.dumps(cfg)],
+            env=env, timeout=budget, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        errors.append(f"{cfg['model']}/{cfg['backend']} "
+                      f"b{cfg['batch']}: timeout {budget}s")
+        return None
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(ln)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            if proc.returncode == 0:
+                return obj
+            break
+    tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+    errors.append(f"{cfg['model']}/{cfg['backend']} b{cfg['batch']}: rc="
+                  f"{proc.returncode} "
+                  f"{tail.splitlines()[-1] if tail else ''}")
+    return None
 
 
 def orchestrate():
     errors = []
+    headline = None
     for env_over, cfg, budget in _attempts():
-        env = dict(os.environ)
-        if env_over is not None:
-            # CPU fallback: strip anything that could claim the tunnel
-            env = {k: v for k, v in env.items()
-                   if not k.startswith(_HOSTILE_ENV_PREFIXES)}
-            env.update(env_over)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker",
-                 json.dumps(cfg)],
-                env=env, timeout=budget, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
-            errors.append(f"{cfg['backend']} b{cfg['batch']}: "
-                          f"timeout {budget}s")
-            continue
-        line = None
-        for ln in reversed(proc.stdout.strip().splitlines()):
-            try:
-                obj = json.loads(ln)
-            except (ValueError, TypeError):
-                continue
-            if isinstance(obj, dict) and "metric" in obj:
-                line = ln
+        headline = _run_worker(env_over, cfg, budget, errors)
+        if headline is not None:
+            break
+    bert = None
+    if headline is not None and not os.environ.get("BENCH_SKIP_BERT"):
+        for env_over, cfg, budget in _bert_attempts():
+            bert = _run_worker(env_over, cfg, budget, errors)
+            if bert is not None:
                 break
-        if proc.returncode == 0 and line:
-            print(line)
-            return 0
-        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
-        errors.append(f"{cfg['backend']} b{cfg['batch']}: rc="
-                      f"{proc.returncode} {tail.splitlines()[-1] if tail else ''}")
-    print(json.dumps({
-        "metric": "resnet50_train_samples_per_sec_per_chip",
-        "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": None,
-        "error": "; ".join(errors)[-500:],
-    }))
+    if headline is None:
+        print(json.dumps({
+            "metric": "resnet50_train_samples_per_sec_per_chip",
+            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": None,
+            "error": "; ".join(errors)[-500:],
+        }))
+        return 0
+    if bert is not None:
+        headline["bert_tokens_per_sec_per_chip"] = bert["value"]
+        headline["bert_mfu"] = bert.get("mfu")
+        headline["bert_batch"] = bert.get("batch")
+        headline["bert_seq"] = bert.get("seq")
+    elif errors:
+        headline["bert_error"] = "; ".join(errors)[-300:]
+    print(json.dumps(headline))
     return 0
+
+
+# -- worker-side helpers -------------------------------------------------------
+
+def _readback(nd):
+    """Force a host readback — the ONLY reliable sync on this backend."""
+    import numpy as np
+
+    arr = getattr(nd, "_data", nd)
+    return np.asarray(arr)
+
+
+def _timed_loop(step, steps, per_step_readback=False):
+    """Time `steps` invocations of `step()`; always readback-terminated."""
+    out = None
+    t0 = time.perf_counter()
+    if per_step_readback:
+        for _ in range(steps):
+            out = _readback(step())
+    else:
+        for _ in range(steps):
+            out = step()
+        out = _readback(out)
+    dt = time.perf_counter() - t0
+    return dt, out
+
+
+def _measure(step, steps, flops_per_step, peak):
+    """warmup + timed loop + MFU sanity gate (remeasure on violation)."""
+    # warmup / compile; readback ends each warmup step so the first timed
+    # step starts from a drained device queue
+    _readback(step())
+    _readback(step())
+    dt, out = _timed_loop(step, steps)
+    mfu = (flops_per_step * steps / dt / peak) if peak else None
+    gated = False
+    if mfu is not None and mfu > _MFU_GATE:
+        # impossible (or suspiciously perfect) number: the async queue
+        # must have leaked past the readback — retime strictly
+        gated = True
+        dt, out = _timed_loop(step, steps, per_step_readback=True)
+        mfu = flops_per_step * steps / dt / peak
+    return dt, mfu, gated, out
+
+
+def _calibrate(peak):
+    """Time a known bf16 matmul chain with readback; returns TFLOP/s.
+
+    An in-run reference point: if the model MFU were ever to exceed
+    calib/peak something is wrong with the timing, not the model.  The
+    chain reduces to a SCALAR before readback and runs enough FLOPs
+    (~17.6 TFLOP) that the tunnel's readback RTT (~30ms measured) is
+    noise, not signal.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n, chain, iters = 8192, 8, 2
+
+    @jax.jit
+    def f(a, b):
+        for _ in range(chain):
+            a = a @ b
+        return jnp.sum(a.astype(jnp.float32))
+
+    a = jnp.asarray(np.random.RandomState(0).standard_normal((n, n)),
+                    jnp.bfloat16)
+    b = jnp.asarray(np.random.RandomState(1).standard_normal((n, n)),
+                    jnp.bfloat16)
+    _readback(f(a, b))  # compile + drain
+    t0 = time.perf_counter()
+    out = [f(a, b) for _ in range(iters)][-1]
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    tflops = iters * chain * 2 * n ** 3 / dt / 1e12
+    if peak and tflops > peak / 1e12:
+        # still raced dispatch somehow; retime strictly per-call
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(f(b, a))
+        dt = time.perf_counter() - t0
+        tflops = iters * chain * 2 * n ** 3 / dt / 1e12
+    return round(tflops, 1)
+
+
+def _peak_for(device):
+    kind = getattr(device, "device_kind", "") or ""
+    for key, val in _PEAK_FLOPS:
+        if key in kind.lower():
+            return kind, val
+    return kind, None
 
 
 def worker(cfg):
@@ -112,8 +259,7 @@ def worker(cfg):
         sys.exit(3)
     if cfg["backend"] != "cpu" and devices[0].platform == "cpu":
         # jax fell back to CPU on a chip-less host: don't burn the TPU
-        # attempt's budget running ResNet-50 on CPU — bail so the parent
-        # moves straight to the sized-for-CPU fallback config
+        # attempt's budget — bail so the parent moves to the CPU config
         sys.stderr.write("requested TPU but only CPU available\n")
         sys.exit(4)
 
@@ -131,6 +277,13 @@ def worker(cfg):
         except Exception:
             pass  # cache is best-effort
 
+    if cfg["model"] == "bert":
+        bench_bert(cfg, devices)
+    else:
+        bench_resnet(cfg, devices)
+
+
+def bench_resnet(cfg, devices):
     import numpy as np
 
     import jax.numpy as jnp
@@ -156,41 +309,103 @@ def worker(cfg):
         (batch_size, 3, image_size, image_size)), dtype=jnp.bfloat16)
     y = jnp.asarray(rng.randint(0, 1000, batch_size).astype("float32"))
 
-    # warmup / compile
-    trainer.step(x, y).wait_to_read()
-    trainer.step(x, y).wait_to_read()
+    kind, peak = _peak_for(devices[0])
+    calib_tflops = (_calibrate(peak)
+                    if devices[0].platform != "cpu" else None)
 
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(steps):
-        loss = trainer.step(x, y)
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
+    flops_per_step = (_RESNET50_TRAIN_FLOPS_224
+                      * (image_size / 224.0) ** 2) * batch_size
 
-    samples_per_sec = batch_size * steps / dt
-    per_chip = samples_per_sec / n_chips
+    dt, mfu, gated, loss_val = _measure(
+        lambda: trainer.step(x, y), steps, flops_per_step, peak)
 
-    kind = getattr(devices[0], "device_kind", "") or ""
-    peak = None
-    for key, val in _PEAK_FLOPS:
-        if key in kind.lower():
-            peak = val
-            break
-    flops_per_sample = (_RESNET50_TRAIN_FLOPS_224
-                        * (image_size / 224.0) ** 2)
-    mfu = (round(per_chip * flops_per_sample / peak, 4)
-           if peak else None)
+    loss = float(np.asarray(loss_val, dtype=np.float32))
+    if not np.isfinite(loss):
+        sys.stderr.write(f"non-finite loss {loss}\n")
+        sys.exit(5)
 
+    per_chip = batch_size * steps / dt / n_chips
     print(json.dumps({
         "metric": "resnet50_train_samples_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": None,
-        "mfu": mfu,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_gated_remeasure": gated,
+        "calib_tflops": calib_tflops,
+        "loss": round(loss, 4),
         "device_kind": kind,
         "backend": devices[0].platform,
         "batch": batch_size,
-        "image": image_size,
+        "image": cfg["image"],
+    }))
+
+
+def bench_bert(cfg, devices):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+    n_chips = max(1, len(devices))
+    batch_size, seq_len, steps = cfg["batch"], cfg["seq"], cfg["steps"]
+
+    net = bert_zoo.bert_base(dropout=0.0, max_length=seq_len,
+                             attention_impl="flash"
+                             if devices[0].platform != "cpu" else "dense")
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+
+    mesh = parallel.data_parallel_mesh(n_chips)
+    trainer = parallel.ShardedTrainer(
+        net, bert_zoo.BERTPretrainLoss(), "adamw",
+        {"learning_rate": 1e-4, "wd": 0.01}, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 30000, (batch_size, seq_len)),
+                         jnp.int32)
+    mlm_labels = np.full((batch_size, seq_len), -1, np.int32)
+    mask_pos = rng.rand(batch_size, seq_len) < 0.15
+    mlm_labels[mask_pos] = rng.randint(
+        0, 30000, int(mask_pos.sum()))
+    mlm_labels = jnp.asarray(mlm_labels)
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (batch_size,)), jnp.int32)
+
+    kind, peak = _peak_for(devices[0])
+
+    # BERT-base FLOPs/token (matmuls only): 12 layers ×
+    # (qkv 3*768*768*2 + attn 2*2*768*T... ) — use the standard 6*N
+    # approximation with N=110e6 params plus attention quadratic term
+    n_params = 110e6
+    attn_flops = 12 * 2 * 2 * seq_len * 768  # per token: QK^T + AV
+    flops_per_token = 3.0 * (2 * n_params + attn_flops)
+    flops_per_step = flops_per_token * batch_size * seq_len
+
+    dt, mfu, gated, loss_val = _measure(
+        lambda: trainer.step(tokens, (mlm_labels, nsp_labels)),
+        steps, flops_per_step, peak)
+
+    loss = float(np.asarray(loss_val, dtype=np.float32))
+    if not np.isfinite(loss):
+        sys.stderr.write(f"non-finite loss {loss}\n")
+        sys.exit(5)
+
+    per_chip = batch_size * seq_len * steps / dt / n_chips
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_gated_remeasure": gated,
+        "loss": round(loss, 4),
+        "device_kind": kind,
+        "backend": devices[0].platform,
+        "batch": batch_size,
+        "seq": seq_len,
     }))
 
 

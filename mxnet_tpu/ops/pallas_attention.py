@@ -2,23 +2,28 @@
 
 Reference parity target: the fused MHA kernels the reference gets from
 contrib/transformer.cu + cuDNN; here the TPU version is a blockwise
-online-softmax kernel (Flash-Attention) so the (Tq × Tk) score matrix never
-materializes in HBM:
+online-softmax kernel (Flash-Attention-2) so neither the (Tq × Tk) score
+matrix nor the whole K/V sequence is ever resident:
 
-- grid over (batch·heads, Tq blocks); K/V stream through VMEM in Tk blocks
-  inside a fori_loop;
+- grid (batch·heads, q blocks, kv blocks): K and V stream through VMEM
+  one (block_k, D) tile per grid step — per-step VMEM is bounded by the
+  block sizes and INDEPENDENT of sequence length (long-context safe);
 - the score block Q·Kᵀ runs on the MXU with f32 accumulation;
-- m/l/o accumulators live in VMEM scratch across the inner loop;
-- causal masking skips fully-masked KV blocks (upper-triangle blocks are
-  never even loaded — the index map keeps them out of the loop bound);
-- the forward also emits the per-row logsumexp L = m + log(l), and the
-  backward is the FlashAttention-2 recipe: recompute the probability
-  block p = exp(s − L) per tile and accumulate dq (one kernel, grid over
-  q blocks) and dk/dv (one kernel, grid over kv blocks) in VMEM — no
-  O(T²) HBM tensor in training either.
+- m/l/o accumulators live in VMEM scratch, carried across the kv grid
+  dimension ("arbitrary" semantics); outputs store on the last kv step;
+- m/l (and the emitted logsumexp) are kept lane-replicated (block_q, 128)
+  so the online-softmax update is pure elementwise VPU work — the same
+  layout trick the production TPU kernels use;
+- causal q/kv block pairs above the diagonal skip all compute (pl.when);
+- backward is the FlashAttention-2 recipe: recompute p = exp(s − L) per
+  tile; dq accumulates over the kv grid, dk/dv over the q grid; D_i =
+  rowsum(dO ∘ O) is computed in-kernel from the O/dO tiles (never
+  materialized in HBM).
 
 Off-TPU (tests, CPU mesh) the kernels run in interpret mode, keeping one
-code path.
+code path.  On TPU, sequence lengths not divisible by 128 fall back to a
+dense XLA path (flash only matters at lengths where T % 128 == 0 is
+free to arrange).
 """
 
 from __future__ import annotations
@@ -37,246 +42,289 @@ def _use_interpret():
 
 
 def _block_sizes(T):
-    block_q = min(max(_LANE, 1), T)
-    while T % block_q:
-        block_q //= 2
-    block_k = min(_LANE, T)
-    while T % block_k:
-        block_k //= 2
-    return block_q, block_k
+    if T % _LANE == 0:
+        bq = 512 if T % 512 == 0 else (256 if T % 256 == 0 else _LANE)
+        return min(bq, T), _LANE
+    # interpret-mode small/odd shapes; real TPU dispatches dense instead
+    return T, T
+
+
+def _bcast_lanes(x, n):
+    """(bq, 128) lane-replicated -> (bq, n)."""
+    if n == _LANE:
+        return x
+    if n % _LANE == 0:
+        return jnp.tile(x, (1, n // _LANE))
+    return x[:, :n]
 
 
 # -- forward -------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
-                      causal, scale, q_block, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, block_q, block_k, nk):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (Bq, D)
-    Bq, D = q.shape
-    nkb = pl.cdiv(seq_len, block_k)
-    if causal:
-        # block row qi attends kv blocks with start <= q_end
-        q_end = (qi + 1) * q_block - 1
-        nkb = jnp.minimum(nkb, (q_end // block_k) + 1)
+    kj = pl.program_id(2)
 
-    def body(j, carry):
-        o, l, m = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _run():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
         if causal:
-            qpos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, block_k), 1)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new[:, None])
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_curr = jnp.max(s, axis=1)[:, None]          # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_curr)          # (bq, 128)
+        p = jnp.exp(s - _bcast_lanes(m_next, s.shape[1]))
         p = jnp.where(s <= _NEG / 2, 0.0, p)
-        alpha = jnp.exp(m - m_new)
-        alpha = jnp.where(m <= _NEG / 2, 0.0, alpha)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        alpha = jnp.exp(m_prev - m_next)
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_scr[...] = m_next
+        v = v_ref[0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return o_new, l_new, m_new
+        D = acc_scr.shape[1]
+        acc_scr[...] = acc_scr[...] * _bcast_lanes(alpha, D) + pv
 
-    o0 = jnp.zeros((Bq, D), jnp.float32)
-    l0 = jnp.zeros((Bq,), jnp.float32)
-    m0 = jnp.full((Bq,), _NEG, jnp.float32)
-    o, l, m = jax.lax.fori_loop(0, nkb, body, (o0, l0, m0))
-    lsafe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (o / lsafe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(lsafe)
+    if causal:
+        pl.when(kj * block_k <= (qi + 1) * block_q - 1)(_run)
+    else:
+        _run()
+
+    @pl.when(kj == nk - 1)
+    def _store():
+        l = l_scr[...]
+        lsafe = jnp.where(l == 0.0, 1.0, l)
+        D = acc_scr.shape[1]
+        o_ref[0] = (acc_scr[...] / _bcast_lanes(lsafe, D)).astype(
+            o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(lsafe)
 
 
-def _flash_call(q, k, v, causal, scale):
+def _flash_call(q, k, v, causal, scale, block_q, block_k):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
     qr = q.reshape(B * H, T, D)
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
-    block_q, block_k = _block_sizes(T)
-    grid = (B * H, T // block_q)
+    nq, nk = T // block_q, T // block_k
+    interpret = _use_interpret()
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
-        q_block=block_q, seq_len=T)
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, nk=nk)
+    kw = {} if interpret else {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))}
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(B * H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            # lane-replicated logsumexp (the layout the bwd kernels eat)
+            jax.ShapeDtypeStruct((B * H, T, _LANE), jnp.float32),
         ],
-        interpret=_use_interpret(),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **kw,
     )(qr, kr, vr)
-    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+    return out.reshape(B, H, T, D), lse
 
 
 # -- backward (FlashAttention-2) -----------------------------------------------
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                     dq_ref, *, block_k, causal, scale, q_block, seq_len):
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref,
+               acc_scr, delta_scr, *, scale, causal, block_q, block_k, nk):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)      # (Bq, D)
-    g = g_ref[0].astype(jnp.float32)      # (Bq, D)
-    lse = lse_ref[0]                      # (Bq,)
-    delta = delta_ref[0]                  # (Bq,)
-    Bq, D = q.shape
-    nkb = pl.cdiv(seq_len, block_k)
-    if causal:
-        q_end = (qi + 1) * q_block - 1
-        nkb = jnp.minimum(nkb, (q_end // block_k) + 1)
+    kj = pl.program_id(2)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        delta_scr[...] = jnp.sum(g * o, axis=1)[:, None] * jnp.ones(
+            (1, _LANE), jnp.float32)
+
+    def _run():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * q_block + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (Bq, block_k), 1)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        bk = s.shape[1]
+        p = jnp.exp(s - _bcast_lanes(lse_ref[0], bk))
         p = jnp.where(s <= _NEG / 2, 0.0, p)
+        v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(                      # dO · Vᵀ
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+        ds = p * (dp - _bcast_lanes(delta_scr[...], bk)) * scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((Bq, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(kj * block_k <= (qi + 1) * block_q - 1)(_run)
+    else:
+        _run()
+
+    @pl.when(kj == nk - 1)
+    def _store():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, block_q, causal, scale, k_block,
-                      seq_len):
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
+                block_k, nq):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)      # (Bk, D)
-    v = v_ref[0].astype(jnp.float32)      # (Bk, D)
-    Bk, D = k.shape
-    nqb = pl.cdiv(seq_len, block_q)
-    # causal: q block rows strictly above this kv block are fully masked
-    start = (ki * k_block) // block_q if causal else 0
+    qj = pl.program_id(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+    @pl.when(qj == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _run():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
         if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, Bk), 0)
-            kpos = ki * k_block + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, Bk), 1)
+            qpos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        bk = s.shape[1]
+        p = jnp.exp(s - _bcast_lanes(lse_ref[0], bk))
         p = jnp.where(s <= _NEG / 2, 0.0, p)
-        dv = dv + jax.lax.dot_general(                  # Pᵀ · dO
-            p, g, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        delta = jnp.sum(g * o, axis=1)[:, None]        # (bq, 1)
+        v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jax.lax.dot_general(                  # dSᵀ · Q
-            ds, q, (((0,), (0,)), ((), ())),
+        ds = p * (dp - delta) * scale
+        dv_scr[...] += jax.lax.dot_general(            # Pᵀ · dO
+            p.astype(g_ref.dtype), g_ref[0],
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] += jax.lax.dot_general(            # dSᵀ · Q
+            ds.astype(q_ref.dtype), q_ref[0],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    z = jnp.zeros((Bk, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, nqb, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        pl.when((qj + 1) * block_q - 1 >= ki * block_k)(_run)
+    else:
+        _run()
+
+    @pl.when(qj == nq - 1)
+    def _store():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_call(q, k, v, out, lse, g, causal, scale):
+def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
+                    block_k):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
     qr = q.reshape(B * H, T, D)
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
     gr = g.reshape(B * H, T, D)
-    lser = lse.reshape(B * H, T)
-    # D_i = rowsum(dO ∘ O) — tiny, XLA fuses it
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(B * H, T)
-    block_q, block_k = _block_sizes(T)
+    outr = out.reshape(B * H, T, D)
+    nq, nk = T // block_q, T // block_k
     interpret = _use_interpret()
+    kw = {} if interpret else {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))}
 
-    dq_kernel = functools.partial(
-        _flash_dq_kernel, block_k=block_k, causal=causal, scale=scale,
-        q_block=block_q, seq_len=T)
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    lspec = pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
-        dq_kernel,
-        grid=(B * H, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(B * H, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, lspec],
+        out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
         interpret=interpret,
-    )(qr, kr, vr, gr, lser, delta)
+        **kw,
+    )(qr, kr, vr, gr, outr, lse)
 
-    dkv_kernel = functools.partial(
-        _flash_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
-        k_block=block_k, seq_len=T)
+    # dkv grid: kv block is the revisited (outer) axis, q streams inner
+    qspec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
+    kspec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    lspec2 = pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(B * H, T // block_k),
-        in_specs=[
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, i: (b, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-        ],
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(B * H, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2],
+        out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
         interpret=interpret,
-    )(qr, kr, vr, gr, lser, delta)
+        **kw,
+    )(qr, kr, vr, gr, outr, lse)
 
     return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
             dv.reshape(B, H, T, D))
@@ -284,14 +332,15 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale):
 
 # -- custom vjp ----------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
-    out, _ = _flash_call(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k)
     return out
 
 
 def _dense_ref(q, k, v, causal, scale):
-    """Dense oracle for tests (and the doc of what the kernel computes)."""
+    """Dense oracle for tests, and the TPU path for T % 128 != 0 (and
+    the doc of what the kernel computes)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -303,21 +352,33 @@ def _dense_ref(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    out, lse = _flash_call(q, k, v, causal, scale)
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd_call(q, k, v, out, lse, g, causal, scale)
+    return _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
-    """Blockwise fused attention; q,k,v: (B, H, T, D)."""
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None):
+    """Blockwise fused attention; q,k,v: (B, H, T, D).
+
+    ``block_q``/``block_k`` override the tile sizes (tests use small
+    blocks to exercise multi-block streaming at modest T)."""
+    T = q.shape[2]
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_core(q, k, v, bool(causal), float(scale))
+    if T % _LANE != 0 and not _use_interpret():
+        # TPU lowering needs 128-aligned tiles; short/odd sequences are
+        # exactly where dense XLA attention is fine anyway
+        return _dense_ref(q, k, v, bool(causal), float(scale))
+    dbq, dbk = _block_sizes(T)
+    return _flash_core(q, k, v, bool(causal), float(scale),
+                       int(block_q or dbq), int(block_k or dbk))

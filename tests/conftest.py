@@ -8,9 +8,13 @@ the analog of the reference's local dmlc tracker for fake multi-node
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+# Must be set before jax is imported anywhere.  Append, don't setdefault:
+# the container exports XLA_FLAGS="" which would defeat setdefault and
+# leave the mesh at 1 device.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 # force, not setdefault: the container env pins JAX_PLATFORMS=axon (the
 # one-chip TPU tunnel) — tests always run on the virtual CPU mesh.  NOTE:
 # the axon tunnel registers in sitecustomize at interpreter start; run
