@@ -127,9 +127,10 @@ def orchestrate():
         if headline is not None:
             break
     bert = None
+    bert_errors = []
     if headline is not None and not os.environ.get("BENCH_SKIP_BERT"):
         for env_over, cfg, budget in _bert_attempts():
-            bert = _run_worker(env_over, cfg, budget, errors)
+            bert = _run_worker(env_over, cfg, budget, bert_errors)
             if bert is not None:
                 break
     if headline is None:
@@ -144,8 +145,8 @@ def orchestrate():
         headline["bert_mfu"] = bert.get("mfu")
         headline["bert_batch"] = bert.get("batch")
         headline["bert_seq"] = bert.get("seq")
-    elif errors:
-        headline["bert_error"] = "; ".join(errors)[-300:]
+    elif bert_errors:
+        headline["bert_error"] = "; ".join(bert_errors)[-300:]
     print(json.dumps(headline))
     return 0
 
@@ -315,9 +316,12 @@ def bench_resnet(cfg, devices):
 
     flops_per_step = (_RESNET50_TRAIN_FLOPS_224
                       * (image_size / 224.0) ** 2) * batch_size
+    # flops_per_step covers the GLOBAL batch: peak scales with chips so
+    # mfu stays per-chip utilization
+    total_peak = peak * n_chips if peak else None
 
     dt, mfu, gated, loss_val = _measure(
-        lambda: trainer.step(x, y), steps, flops_per_step, peak)
+        lambda: trainer.step(x, y), steps, flops_per_step, total_peak)
 
     loss = float(np.asarray(loss_val, dtype=np.float32))
     if not np.isfinite(loss):
@@ -383,10 +387,11 @@ def bench_bert(cfg, devices):
     attn_flops = 12 * 2 * 2 * seq_len * 768  # per token: QK^T + AV
     flops_per_token = 3.0 * (2 * n_params + attn_flops)
     flops_per_step = flops_per_token * batch_size * seq_len
+    total_peak = peak * n_chips if peak else None
 
     dt, mfu, gated, loss_val = _measure(
         lambda: trainer.step(tokens, (mlm_labels, nsp_labels)),
-        steps, flops_per_step, peak)
+        steps, flops_per_step, total_peak)
 
     loss = float(np.asarray(loss_val, dtype=np.float32))
     if not np.isfinite(loss):

@@ -11,9 +11,11 @@ matrix nor the whole K/V sequence is ever resident:
 - the score block Q·Kᵀ runs on the MXU with f32 accumulation;
 - m/l/o accumulators live in VMEM scratch, carried across the kv grid
   dimension ("arbitrary" semantics); outputs store on the last kv step;
-- m/l (and the emitted logsumexp) are kept lane-replicated (block_q, 128)
-  so the online-softmax update is pure elementwise VPU work — the same
-  layout trick the production TPU kernels use;
+- m/l are kept lane-replicated (block_q, 128) in VMEM so the
+  online-softmax update is pure elementwise VPU work — the same layout
+  trick the production TPU kernels use; the logsumexp persisted to HBM
+  for the backward is narrowed to (B·H, T, 8) (the minimum Mosaic-legal
+  lane tile) and re-broadcast from lane 0 inside the bwd kernels;
 - causal q/kv block pairs above the diagonal skip all compute (pl.when);
 - backward is the FlashAttention-2 recipe: recompute p = exp(s − L) per
   tile; dq accumulates over the kv grid, dk/dv over the q grid; D_i =
@@ -49,13 +51,21 @@ def _block_sizes(T):
     return T, T
 
 
+# lanes of logsumexp/delta actually persisted to HBM between fwd and bwd
+# (sublane-legal minimum; ×8 instead of the kernels' working ×128)
+_LSE_LANES = 8
+
+
 def _bcast_lanes(x, n):
-    """(bq, 128) lane-replicated -> (bq, n)."""
-    if n == _LANE:
+    """lane-replicated (bq, k) -> (bq, n); every lane of x is identical."""
+    k = x.shape[1]
+    if n == k:
         return x
-    if n % _LANE == 0:
-        return jnp.tile(x, (1, n // _LANE))
-    return x[:, :n]
+    if n < k:
+        return x[:, :n]
+    if n % k == 0:
+        return jnp.tile(x, (1, n // k))
+    return jnp.broadcast_to(x[:, :1], (x.shape[0], n))
 
 
 # -- forward -------------------------------------------------------------------
@@ -113,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         D = acc_scr.shape[1]
         o_ref[0] = (acc_scr[...] / _bcast_lanes(lsafe, D)).astype(
             o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(lsafe)
+        lse_ref[0] = (m_scr[...] + jnp.log(lsafe))[:, :_LSE_LANES]
 
 
 def _flash_call(q, k, v, causal, scale, block_q, block_k):
@@ -142,12 +152,14 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            # lane-replicated logsumexp (the layout the bwd kernels eat)
-            jax.ShapeDtypeStruct((B * H, T, _LANE), jnp.float32),
+            # logsumexp, ×8 sublane-replicated (narrowest Mosaic-legal
+            # lane tile — ×128 would cost 16× the HBM for no information)
+            jax.ShapeDtypeStruct((B * H, T, _LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -191,7 +203,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref,
                 jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
         bk = s.shape[1]
-        p = jnp.exp(s - _bcast_lanes(lse_ref[0], bk))
+        p = jnp.exp(s - _bcast_lanes(lse_ref[0][:, :1], bk))
         p = jnp.where(s <= _NEG / 2, 0.0, p)
         v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(                      # dO · Vᵀ
@@ -241,7 +253,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dk_ref,
                 jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
         bk = s.shape[1]
-        p = jnp.exp(s - _bcast_lanes(lse_ref[0], bk))
+        p = jnp.exp(s - _bcast_lanes(lse_ref[0][:, :1], bk))
         p = jnp.where(s <= _NEG / 2, 0.0, p)
         delta = jnp.sum(g * o, axis=1)[:, None]        # (bq, 1)
         v = v_ref[0].astype(jnp.float32)
@@ -288,7 +300,8 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
 
     qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
-    lspec = pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0))
+    lspec = pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk),
@@ -307,7 +320,8 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
     # dkv grid: kv block is the revisited (outer) axis, q streams inner
     qspec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
     kspec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
-    lspec2 = pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, j, 0))
+    lspec2 = pl.BlockSpec((1, block_q, _LSE_LANES),
+                          lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq),
@@ -380,5 +394,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
         # exactly where dense XLA attention is fine anyway
         return _dense_ref(q, k, v, bool(causal), float(scale))
     dbq, dbk = _block_sizes(T)
-    return _flash_core(q, k, v, bool(causal), float(scale),
-                       int(block_q or dbq), int(block_k or dbk))
+    bq, bk = int(block_q or dbq), int(block_k or dbk)
+    if T % bq or T % bk:
+        raise ValueError(
+            f"flash_attention: block sizes ({bq}, {bk}) must divide "
+            f"sequence length {T} (a non-dividing block would silently "
+            f"leave tail blocks unwritten)")
+    return _flash_core(q, k, v, bool(causal), float(scale), bq, bk)
