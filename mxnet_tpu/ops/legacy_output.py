@@ -106,23 +106,41 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
               normalization="null"):
     """Marks a symbol as a loss terminal: forward = identity, backward =
-    grad_scale (reference: make_loss.cc)."""
+    grad_scale, normalized per ``normalization`` (reference:
+    make_loss.cc):
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-    def op(data, scale):
+    - ``'null'``  — d(data) = grad_scale
+    - ``'batch'`` — d(data) = grad_scale / batch_size
+    - ``'valid'`` — d(data) = grad_scale / #{elements > valid_thresh}
+      (the reference counts valid loss entries in the DATA itself,
+      clamped to >= 1)
+    """
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+    def op(data, scale, norm, thresh):
         return data
 
-    def fwd(data, scale):
-        return data, data.shape
+    def fwd(data, scale, norm, thresh):
+        return data, data
 
-    def bwd(scale, shape, g):
-        return (jnp.full(shape, scale),)
+    def bwd(scale, norm, thresh, data, g):
+        if norm == "batch":
+            denom = jnp.asarray(data.shape[0], jnp.float32)
+        elif norm == "valid":
+            denom = jnp.maximum(
+                jnp.sum(data > thresh).astype(jnp.float32), 1.0)
+        else:
+            denom = jnp.asarray(1.0, jnp.float32)
+        return (jnp.full(data.shape, scale,
+                         jnp.float32).astype(data.dtype)
+                / denom.astype(data.dtype),)
 
     op.defvjp(fwd, bwd)
-    scale = float(grad_scale)
-    if normalization == "batch":
-        scale = scale  # resolved against shape in bwd via full
-    return op(data, scale)
+    if normalization not in ("null", "batch", "valid"):
+        raise ValueError(f"MakeLoss: unknown normalization "
+                         f"{normalization!r}")
+    return op(data, float(grad_scale), normalization,
+              float(valid_thresh))
 
 
 @register("all_finite")
