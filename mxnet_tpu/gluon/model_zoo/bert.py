@@ -214,6 +214,101 @@ class BERTPretrainLoss(HybridBlock):
                               nsp_labels))
 
 
+class BERTEmbedding(HybridBlock):
+    """Token + type + position embedding front (the pipeline prologue).
+
+    The prologue takes token ids only, so the token-type table holds just
+    segment 0 — shape (1, units), an additive bias; a bigger table would
+    be dead trainable parameters in the pipeline's replicated group."""
+
+    def __init__(self, vocab_size=30522, units=768, max_length=512,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._dropout = dropout
+        with self.name_scope():
+            self.word_embed_weight = self.params.get(
+                "word_embed_weight", shape=(vocab_size, units),
+                init="normal")
+            self.token_type_embed_weight = self.params.get(
+                "token_type_embed_weight", shape=(1, units),
+                init="normal")
+            self.position_embed_weight = self.params.get(
+                "position_embed_weight", shape=(max_length, units),
+                init="normal")
+            self.embed_ln = nn.LayerNorm(in_channels=units)
+            if dropout:
+                self.embed_drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, inputs, word_embed_weight=None,
+                       token_type_embed_weight=None,
+                       position_embed_weight=None):
+        T = inputs.shape[1]
+        x = F.Embedding(inputs, word_embed_weight)
+        x = x + token_type_embed_weight[0]
+        x = x + position_embed_weight[:T]
+        x = self.embed_ln(x)
+        if self._dropout:
+            x = self.embed_drop(x)
+        return x
+
+
+class BERTMLMHead(HybridBlock):
+    """Transform + decode-to-vocab head (the pipeline epilogue).  The
+    decode weight is untied here (pipeline stages own disjoint params)."""
+
+    def __init__(self, vocab_size=30522, units=768, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.transform = nn.Dense(units, activation="gelu",
+                                      in_units=units, flatten=False,
+                                      prefix="transform_")
+            self.ln = nn.LayerNorm(in_channels=units)
+            self.decoder = nn.Dense(vocab_size, in_units=units,
+                                    flatten=False, prefix="decoder_")
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.ln(self.transform(x)))
+
+
+class BERTMLMLoss(HybridBlock):
+    """Masked-LM cross entropy over head logits; labels (B, T), -1 at
+    unmasked positions."""
+
+    def hybrid_forward(self, F, logits, labels):
+        from ...ndarray.register import invoke_simple
+
+        def pure(logits, labels):
+            import jax
+            import jax.numpy as jnp
+
+            labels = labels.astype(jnp.int32)
+            valid = labels >= 0
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+            denom = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+
+        return invoke_simple(pure, (logits, labels))
+
+
+def bert_pipeline_parts(vocab_size=30522, units=768, num_layers=12,
+                        num_heads=12, hidden_size=None, max_length=512,
+                        dropout=0.0, attention_impl="dense"):
+    """(prologue, trunk stages, epilogue) for parallel.PipelineTrainer:
+    a full BERT as embedding + homogeneous encoder layers + MLM head."""
+    embed = BERTEmbedding(vocab_size=vocab_size, units=units,
+                          max_length=max_length, dropout=dropout,
+                          prefix="ppembed_")
+    layers = [TransformerEncoderLayer(
+        units, num_heads, hidden_size or 4 * units, dropout,
+        attention_impl, prefix=f"pplayer{i}_") for i in range(num_layers)]
+    head = BERTMLMHead(vocab_size=vocab_size, units=units,
+                       prefix="pphead_")
+    return embed, layers, head
+
+
 def bert_base(**kwargs):
     return BERTModel(units=768, num_layers=12, num_heads=12,
                      hidden_size=3072, **kwargs)

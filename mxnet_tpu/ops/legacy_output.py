@@ -116,24 +116,28 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
       clamped to >= 1)
     """
 
+    shape, dtype = tuple(data.shape), data.dtype
+
     @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
     def op(data, scale, norm, thresh):
         return data
 
     def fwd(data, scale, norm, thresh):
-        return data, data
+        # only 'valid' needs the values in the backward; 'null'/'batch'
+        # use the closure shape so the loss tensor isn't held live
+        return data, (data if norm == "valid" else None)
 
-    def bwd(scale, norm, thresh, data, g):
-        if norm == "batch":
-            denom = jnp.asarray(data.shape[0], jnp.float32)
-        elif norm == "valid":
+    def bwd(scale, norm, thresh, res, g):
+        if norm == "valid":
             denom = jnp.maximum(
-                jnp.sum(data > thresh).astype(jnp.float32), 1.0)
+                jnp.sum(res > thresh).astype(jnp.float32), 1.0)
         else:
-            denom = jnp.asarray(1.0, jnp.float32)
-        return (jnp.full(data.shape, scale,
-                         jnp.float32).astype(data.dtype)
-                / denom.astype(data.dtype),)
+            denom = jnp.asarray(
+                float(shape[0]) if norm == "batch" else 1.0, jnp.float32)
+        # divide in f32: casting denom to f16 first overflows past 65504
+        # valid elements (grad silently zero) and underflows tiny ratios
+        return (jnp.full(shape, scale / denom, jnp.float32)
+                .astype(dtype),)
 
     op.defvjp(fwd, bwd)
     if normalization not in ("null", "batch", "valid"):

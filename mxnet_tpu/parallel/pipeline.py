@@ -121,14 +121,26 @@ class PipelineTrainer:
     optimizer updates each stage's shard in place — all in one jit with
     donated buffers.
 
-    v1 limits (documented, reference has no pipeline at all): stages must
-    be aux-free (no BatchNorm running stats) and share one input/output
-    shape; the loss attaches to the last stage's output.
+    A real model needs more than the homogeneous trunk: ``prologue``
+    (e.g. token embedding) runs before the pipe and ``epilogue`` (e.g.
+    the MLM head) after it.  Their parameters are replicated on the pp
+    axis and their compute is bulk-synchronous around the scan schedule —
+    on an SPMD pp mesh every device redundantly computes them, which
+    costs no wall-clock (the alternative is those devices idling) and
+    keeps the scanned schedule shape-uniform, which is what lets one XLA
+    program express the whole pipeline.  This pipelines a full BERT
+    (embedding + N encoder layers + MLM head); see
+    gluon.model_zoo.bert.bert_pipeline_parts.
+
+    v1 limits (documented, reference has no pipeline at all): all blocks
+    must be aux-free (no BatchNorm running stats) and trunk stages share
+    one input/output shape; the loss attaches to the epilogue's (or last
+    stage's) output.
     """
 
     def __init__(self, stages, loss_fn, optimizer="sgd",
                  optimizer_params=None, mesh=None, n_microbatches=None,
-                 axis=PP):
+                 axis=PP, prologue=None, epilogue=None):
         import jax
 
         from .trainer import _PureOptimizer
@@ -141,6 +153,8 @@ class PipelineTrainer:
         self.n_stages = mesh.shape.get(axis, 1)
         self.loss_fn = loss_fn
         self.stages = self._as_stages(stages)
+        self.prologue = prologue
+        self.epilogue = epilogue
         self.n_micro = int(n_microbatches or self.n_stages)
         if self.n_micro < self.n_stages:
             raise MXNetError("n_microbatches must be >= n_stages")
@@ -180,8 +194,18 @@ class PipelineTrainer:
 
     # -- staging ---------------------------------------------------------------
 
+    def _collect_trainable(self, block, what):
+        items = list(block.collect_params().items())
+        bad = [n for n, p in items if p.grad_req == "null"]
+        if bad:
+            raise MXNetError(
+                f"PipelineTrainer: aux params unsupported in v1 "
+                f"({what} has {bad})")
+        return items
+
     def _stage_params(self, example):
-        """Materialize deferred shapes, stack per-stage params on pp."""
+        """Materialize deferred shapes, stack per-stage params on pp;
+        prologue/epilogue params are replicated."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
@@ -189,29 +213,27 @@ class PipelineTrainer:
         from .. import autograd as _ag
         from ..gluon.block import _TRACE
 
-        # resolve deferred init by running each stage once, chained
+        # resolve deferred init by running the whole chain once
         prev = _TRACE.force_eager
         _TRACE.force_eager = True
         try:
             with _ag.pause():
                 h = example
+                if self.prologue is not None:
+                    h = self.prologue(h)
                 for s in self.stages:
                     h = s(h)
+                if self.epilogue is not None:
+                    self.epilogue(h)
         finally:
             _TRACE.force_eager = prev
 
         # structural (registration) order, NOT name sort: lexicographic
         # names permute across stages once indices hit two digits
         # (dense9 > dense10), mis-pairing weights between stages
-        per_stage = []
-        for s in self.stages:
-            items = list(s.collect_params().items())
-            bad = [n for n, p in items if p.grad_req == "null"]
-            if bad:
-                raise MXNetError(
-                    f"PipelineTrainer: aux params unsupported in v1 "
-                    f"(stage has {bad})")
-            per_stage.append([p.data()._data for _, p in items])
+        per_stage = [
+            [p.data()._data for _, p in self._collect_trainable(s, "stage")]
+            for s in self.stages]
         shapes = [[tuple(a.shape) for a in vals] for vals in per_stage]
         if any(sh != shapes[0] for sh in shapes[1:]):
             raise MXNetError(
@@ -225,14 +247,36 @@ class PipelineTrainer:
                    for j in range(len(per_stage[0]))]
         self._pspec = NamedSharding(self.mesh, PartitionSpec(self.axis))
         self._repl = NamedSharding(self.mesh, PartitionSpec())
-        self._param_vals = [jax.device_put(a, self._pspec)
-                            for a in stacked]
-        self._opt_state = [
-            tuple(jax.device_put(s, self._pspec) for s in states)
-            for states in self.optimizer.init_state(self._param_vals)]
+        self._n_trunk = len(stacked)
+        param_vals = [jax.device_put(a, self._pspec) for a in stacked]
+        shardings = [self._pspec] * len(stacked)
         tmpl = list(self._template.collect_params().items())
-        self._wd_mults = [p.wd_mult for _, p in tmpl]
-        self._lr_mults = [p.lr_mult for _, p in tmpl]
+        wd = [p.wd_mult for _, p in tmpl]
+        lr = [p.lr_mult for _, p in tmpl]
+
+        # prologue/epilogue: replicated leaves appended after the trunk
+        self._edge_ids = {}
+        for name, block in (("prologue", self.prologue),
+                            ("epilogue", self.epilogue)):
+            if block is None:
+                self._edge_ids[name] = []
+                continue
+            items = self._collect_trainable(block, name)
+            self._edge_ids[name] = [id(p) for _, p in items]
+            param_vals += [jax.device_put(p.data()._data, self._repl)
+                           for _, p in items]
+            shardings += [self._repl] * len(items)
+            wd += [p.wd_mult for _, p in items]
+            lr += [p.lr_mult for _, p in items]
+
+        self._param_vals = param_vals
+        self._param_shardings = shardings
+        self._opt_state = [
+            tuple(jax.device_put(s, sh) for s in states)
+            for states, sh in zip(self.optimizer.init_state(param_vals),
+                                  shardings)]
+        self._wd_mults = wd
+        self._lr_mults = lr
         self._initialized = True
 
     def _build_step(self, batch_shape):
@@ -256,32 +300,53 @@ class PipelineTrainer:
 
         from ._compat import shard_map
 
-        def stage_fn(stage_vals, x):
-            pm = dict(zip(t_ids, stage_vals))
+        n_trunk = self._n_trunk
+        prologue, epilogue = self.prologue, self.epilogue
+        pro_ids = list(self._edge_ids["prologue"])
+        epi_ids = list(self._edge_ids["epilogue"])
+        n_pro = len(pro_ids)
+
+        def _run_block(block, ids, vals, x):
+            pm = dict(zip(ids, vals))
             prev_map = _TRACE.param_map
             _TRACE.param_map = pm
             try:
                 with _ag.train_mode():
-                    return template.forward(x)
+                    return block.forward(x)
             finally:
                 _TRACE.param_map = prev_map
 
-        pspec_tree = [PartitionSpec(axis) for _ in self._param_vals]
+        def stage_fn(stage_vals, x):
+            return _run_block(template, t_ids, stage_vals, x)
 
-        def fwd_micro(param_vals, xs):
+        pspec_tree = [PartitionSpec(axis) for _ in range(n_trunk)]
+
+        def fwd_micro(trunk_vals, xs):
             local = lambda params, xs_: _pipeline_outs(
                 stage_fn, n_stages, n_micro, axis, params, xs_)
             fn = shard_map(local, mesh=mesh,
                            in_specs=(pspec_tree, PartitionSpec()),
                            out_specs=PartitionSpec())
-            return fn(param_vals, xs)
+            return fn(trunk_vals, xs)
 
         def pure_step(param_vals, opt_state, x, y, key, lr, t):
             def loss_of(pv):
-                xs = x.reshape((n_micro, -1) + x.shape[1:])
+                trunk = pv[:n_trunk]
+                pro = pv[n_trunk:n_trunk + n_pro]
+                epi = pv[n_trunk + n_pro:]
                 with _random.key_scope(key):
-                    outs = fwd_micro(pv, xs)
+                    h = x
+                    if prologue is not None:
+                        # replicated on pp: every device computes the
+                        # embedding for the full batch (no wall-clock
+                        # cost — they'd be idle), grads come out
+                        # identical, optimizer updates stay replicated
+                        h = _run_block(prologue, pro_ids, pro, h)
+                    hs = h.reshape((n_micro, -1) + h.shape[1:])
+                    outs = fwd_micro(trunk, hs)
                     outs = outs.reshape((-1,) + outs.shape[2:])
+                    if epilogue is not None:
+                        outs = _run_block(epilogue, epi_ids, epi, outs)
                     loss = loss_block(outs, y) \
                         if loss_block is not None else outs
                 return jnp.mean(loss)
@@ -296,14 +361,16 @@ class PipelineTrainer:
             self._step_fn = jax.jit(
                 pure_step,
                 in_shardings=(
-                    [self._pspec] * len(self._param_vals),
-                    [tuple(self._pspec for _ in st)
-                     for st in self._opt_state],
+                    list(self._param_shardings),
+                    [tuple(sh for _ in st)
+                     for st, sh in zip(self._opt_state,
+                                       self._param_shardings)],
                     self._repl, self._repl, None, None, None),
                 out_shardings=(
-                    [self._pspec] * len(self._param_vals),
-                    [tuple(self._pspec for _ in st)
-                     for st in self._opt_state],
+                    list(self._param_shardings),
+                    [tuple(sh for _ in st)
+                     for st, sh in zip(self._opt_state,
+                                       self._param_shardings)],
                     self._repl),
                 donate_argnums=(0, 1))
 
@@ -341,8 +408,16 @@ class PipelineTrainer:
         return _from_jax(loss)
 
     def sync_params(self):
-        """Write stage slices back into the Gluon Parameters."""
-        for j, stacked in enumerate(self._param_vals):
+        """Write stage slices (and replicated prologue/epilogue values)
+        back into the Gluon Parameters."""
+        for j, stacked in enumerate(self._param_vals[:self._n_trunk]):
             for s, stage in enumerate(self.stages):
                 items = list(stage.collect_params().items())
                 items[j][1].data()._set_data(stacked[s])
+        i = self._n_trunk
+        for block in (self.prologue, self.epilogue):
+            if block is None:
+                continue
+            for _, p in block.collect_params().items():
+                p.data()._set_data(self._param_vals[i])
+                i += 1

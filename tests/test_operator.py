@@ -580,3 +580,20 @@ def test_make_loss_normalization_modes():
         np.full_like(data, 0.5), rtol=1e-6)
     with pytest.raises(ValueError):
         nd.MakeLoss(nd.array(data), normalization="bogus")
+
+
+def test_make_loss_valid_f16_large_count():
+    """f16 loss with >65504 valid elements: the normalizing division must
+    run in f32 (an f16 denominator overflows to inf → zero gradient)."""
+    from mxnet_tpu import autograd
+
+    x = nd.array(np.ones((256, 512), np.float16), dtype="float16")
+    x.attach_grad()
+    with autograd.record():
+        y = nd.MakeLoss(x, normalization="valid", valid_thresh=0.5)
+    y.backward()
+    g = x.grad.asnumpy()
+    expect = np.float16(1.0 / (256 * 512))
+    assert g.dtype == np.float16
+    assert np.all(g > 0), "gradient flushed to zero"
+    np.testing.assert_allclose(g, np.full_like(g, expect), rtol=1e-2)
