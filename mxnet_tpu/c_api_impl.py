@@ -1,0 +1,137 @@
+"""Python-side implementation of the flat C API.
+
+Reference parity: src/c_api/c_api.cc + c_api_ndarray.cc — the reference's
+C ABI wraps its C++ engine; ours wraps the JAX/XLA engine, so the C
+library (src/c_api.cc, built as libmxtpu.so) embeds CPython and calls
+the helpers below.  Every function here takes/returns only simple types
+(bytes, str, int, tuples, NDArray handles) so the C side needs no jax or
+numpy marshalling — handles cross the ABI as opaque PyObject*.
+
+The contract mirrors include/mxnet/c_api.h's shape: NDArray create/copy/
+shape/free, MXImperativeInvoke-style op dispatch with string-encoded
+params, autograd record/backward/grad, and KVStore create/init/push/pull.
+"""
+
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+_RECORD_SCOPES = []
+_KVSTORES = {}
+_NEXT_KV = [1]
+
+
+def create(buf, shape, dtype):
+    """bytes + shape + dtype name -> NDArray handle."""
+    from . import ndarray as nd
+
+    arr = np.frombuffer(bytes(buf), dtype=np.dtype(dtype))
+    arr = arr.reshape(tuple(shape)).copy()
+    return nd.array(arr, dtype=np.dtype(dtype))
+
+
+def to_bytes(h):
+    return h.asnumpy().tobytes()
+
+
+def shape_of(h):
+    return tuple(int(s) for s in h.shape)
+
+
+def dtype_of(h):
+    return np.dtype(h.dtype).name
+
+
+def size_bytes(h):
+    return int(h.size) * np.dtype(h.dtype).itemsize
+
+
+def invoke(name, inputs, keys, vals):
+    """MXImperativeInvoke: op by registered name, params as strings
+    (literal-eval'd like the reference's string-typed param dict).
+    Resolves through the op registry — the same source of truth as
+    MXListAllOpNames — so only real ops are invocable and unknown names
+    raise cleanly.  Returns a list of output handles."""
+    from .ndarray.register import invoke_registered
+
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    out = invoke_registered(name, tuple(inputs), kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def list_op_names():
+    from .ops.registry import list_ops
+
+    return list_ops()
+
+
+# -- autograd ------------------------------------------------------------------
+
+def attach_grad(h):
+    h.attach_grad()
+
+
+def record_start():
+    from . import autograd
+
+    scope = autograd.record()
+    scope.__enter__()
+    _RECORD_SCOPES.append(scope)
+
+
+def record_stop():
+    if _RECORD_SCOPES:
+        _RECORD_SCOPES.pop().__exit__(None, None, None)
+
+
+def backward(h):
+    h.backward()
+
+
+def grad_of(h):
+    g = h.grad
+    if g is None:
+        raise ValueError("no gradient attached")
+    return g
+
+
+# -- kvstore -------------------------------------------------------------------
+
+def kv_create(kind):
+    from . import kvstore
+
+    kv = kvstore.create(kind)
+    kid = _NEXT_KV[0]
+    _NEXT_KV[0] += 1
+    _KVSTORES[kid] = kv
+    return kid
+
+
+def kv_init(kid, key, h):
+    _KVSTORES[kid].init(int(key), h)
+
+
+def kv_push(kid, key, h):
+    _KVSTORES[kid].push(int(key), h)
+
+
+def kv_pull(kid, key):
+    from . import ndarray as nd
+
+    kv = _KVSTORES[kid]
+    # pull() fills a caller buffer (reference semantics); a missing key
+    # raises MXNetError from the store itself
+    out = nd.zeros(kv._store[int(key)].shape)
+    kv.pull(int(key), out=out)
+    return out
+
+
+def kv_free(kid):
+    _KVSTORES.pop(kid, None)
