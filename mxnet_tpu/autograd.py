@@ -238,12 +238,34 @@ def _accum_var(var_accum, arr, ct):
 def _apply_grad(arr, ct):
     import jax.numpy as jnp
 
-    ct = ct.astype(arr._grad._data.dtype) if hasattr(ct, "astype") else ct
+    from .ndarray.sparse import RowSparseNDArray, _RowSparseCt
+
+    grad = arr._grad
+    if isinstance(ct, _RowSparseCt):
+        ct = ct.astype(grad._rs_values.dtype
+                       if isinstance(grad, RowSparseNDArray)
+                       else grad._data.dtype)
+        if isinstance(grad, RowSparseNDArray):
+            # compact write: O(touched rows), never O(table rows)
+            if arr._grad_req == "add" and grad.num_stored_rows:
+                ct = ct + _RowSparseCt(grad._rs_indices,
+                                       grad._rs_values, ct.shape)
+            ct = ct.coalesce()
+            grad._set_sparse(ct.indices, ct.values)
+            return
+        # dense grad buffer: scatter the compact rows in
+        if arr._grad_req == "add":
+            grad._data = grad._data.at[ct.indices].add(ct.values)
+        else:
+            grad._data = ct.to_dense()
+        grad._version += 1
+        return
+    ct = ct.astype(grad._data.dtype) if hasattr(ct, "astype") else ct
     if arr._grad_req == "add":
-        arr._grad._data = arr._grad._data + ct
+        grad._data = grad._data + ct
     else:  # write
-        arr._grad._data = jnp.asarray(ct)
-    arr._grad._version += 1
+        grad._data = jnp.asarray(ct)
+    grad._version += 1
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -124,10 +124,11 @@ def kv_push(kid, key, h):
 
 def kv_pull(kid, key):
     from . import ndarray as nd
+    from .base import MXNetError
 
     kv = _KVSTORES[kid]
-    # pull() fills a caller buffer (reference semantics); a missing key
-    # raises MXNetError from the store itself
+    if int(key) not in kv._store:
+        raise MXNetError(f"key {int(key)} not initialized")
     out = nd.zeros(kv._store[int(key)].shape)
     kv.pull(int(key), out=out)
     return out

@@ -365,14 +365,27 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = bool(sparse_grad)
         self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim),
                 init=weight_initializer, dtype=dtype,
-                allow_deferred_init=True)
+                allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        from ...autograd import is_recording
+        from ...ndarray.ndarray import NDArray
+
+        if self._sparse_grad and isinstance(x, NDArray) \
+                and isinstance(weight, NDArray) and is_recording():
+            # eager tape: compact row-sparse weight gradient (under jit
+            # the dense gather's scatter-add transpose is already the
+            # fused row update, so the plain path is used there)
+            from ...ops.indexing import sparse_embedding
+
+            return sparse_embedding(x, weight)
         return F.Embedding(x, weight, **self._kwargs)
 
     def __repr__(self):

@@ -188,7 +188,7 @@ class Parameter:
             self._init_grad()
 
     def _init_grad(self):
-        self._data.attach_grad(self._grad_req)
+        self._data.attach_grad(self._grad_req, stype=self._grad_stype)
         self._grad = self._data._grad
 
     def initialize(self, init=None, ctx=None, default_init=None,
@@ -273,6 +273,16 @@ class Parameter:
             return
         import jax.numpy as jnp
 
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(self._grad, RowSparseNDArray):
+            # drop to the empty compact form — densifying a big
+            # embedding's grad just to zero it would be O(table)
+            g = self._grad
+            g._set_sparse(jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((0,) + g.shape[1:],
+                                    g._rs_values.dtype))
+            return
         self._grad._set_data(jnp.zeros_like(self._grad._data))
 
     def cast(self, dtype):

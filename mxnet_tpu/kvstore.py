@@ -163,8 +163,33 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # sparse storage is dense on TPU (see ndarray/sparse.py)
-        self.pull(key, out, priority)
+        """Pull ONLY the requested rows as compact row-sparse arrays —
+        the reference's big-embedding bandwidth optimization
+        (src/kvstore/kvstore_local.h row_sparse path).  Without row_ids
+        this degrades to a dense pull."""
+        if row_ids is None:
+            self.pull(key, out, priority)
+            return
+        import jax.numpy as jnp
+
+        from .ndarray.sparse import RowSparseNDArray
+
+        assert out is not None, "row_sparse_pull requires out="
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            stored = self._store[k]
+            idx = jnp.unique(rid._data.astype(jnp.int32).reshape(-1))
+            vals = jnp.take(stored._data, idx, axis=0)
+            for dst in _as_list(o):
+                if isinstance(dst, RowSparseNDArray):
+                    dst._set_sparse(idx, vals)
+                else:
+                    dst._set_data(jnp.zeros(
+                        stored.shape, vals.dtype).at[idx].set(vals))
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)

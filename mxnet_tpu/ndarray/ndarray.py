@@ -159,7 +159,14 @@ class NDArray:
     def attach_grad(self, grad_req="write", stype=None):
         import jax.numpy as jnp
 
-        self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        if stype == "row_sparse":
+            # compact gradient buffer: O(touched rows) after backward
+            from .sparse import zeros as sparse_zeros
+
+            self._grad = sparse_zeros("row_sparse", self.shape,
+                                      self._ctx, self._data.dtype)
+        else:
+            self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
         self._grad_req = grad_req
         self._tape_node = None
 
@@ -362,8 +369,15 @@ class NDArray:
 
     # -- sparse-compat ---------------------------------------------------------
     def tostype(self, stype):
-        out = NDArray(self._data, self._ctx, stype=stype)
-        return out
+        if stype == "row_sparse":
+            from .sparse import row_sparse_array
+
+            return row_sparse_array(self)
+        if stype == "csr":
+            from .sparse import csr_matrix
+
+            return csr_matrix(self)
+        return NDArray(self._data, self._ctx)
 
     # reshape needs to support reshape(2,3), reshape((2,3)), and special codes
     def reshape(self, *shape, **kwargs):

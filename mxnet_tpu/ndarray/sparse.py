@@ -1,17 +1,26 @@
-"""mx.nd.sparse — sparse NDArray API surface.
+"""mx.nd.sparse — sparse NDArray storage and API surface.
 
 Reference parity: python/mxnet/ndarray/sparse.py (RowSparseNDArray,
-CSRNDArray, row_sparse_array, csr_matrix).
+CSRNDArray, row_sparse_array, csr_matrix) over src/ndarray/ndarray.cc's
+sparse chunks.
 
-TPU-first design decision: XLA has no sparse buffer layout, and on TPU the
-MXU/VPU want dense tiles — the reference's sparse storage exists to optimize
-*CPU/PCIe-era* embedding gradients and parameter-server traffic.  Here sparse
-arrays are VIEWS carrying stype metadata plus the compressed components,
-backed by dense compute.  ``row_sparse`` keeps (indices, values) so
-`row_sparse_pull`-style flows and sparse serialization remain expressible;
-compute densifies lazily.  This preserves the full API while XLA's
-scatter/gather fusion covers the perf case that matters on TPU
-(Embedding with sparse_grad lowers to scatter-add, not a dense update).
+TPU-first design: XLA has no sparse buffer layout and the MXU wants
+dense tiles, so sparse COMPUTE densifies at the op boundary (any dense
+op touching a sparse array reads a scattered dense view).  Sparse
+STORAGE, however, is real and compact:
+
+- ``RowSparseNDArray`` holds (indices (K,), values (K, cols...)) plus
+  the logical shape — O(K) device memory, never O(rows), until an op
+  explicitly materializes a dense view;
+- Embedding(sparse_grad=True) produces a compact row-sparse gradient on
+  the eager tape (O(touched rows), the reference's key memory/comm
+  optimization for big embeddings), and the optimizer layer performs
+  the reference's lazy row-wise update straight from the compact parts;
+- KVStore.row_sparse_pull gathers only the requested rows.
+
+Under jit (hybridize / ShardedTrainer) gradients stay dense: XLA's
+scatter-add transpose of the gather IS the fused row-update — compact
+storage there would only add host round-trips.
 """
 
 from __future__ import annotations
@@ -22,122 +31,321 @@ from ..base import MXNetError
 from .ndarray import NDArray, _from_jax
 
 
+class _RowSparseCt:
+    """Row-sparse cotangent flowing through the autograd tape.
+
+    Indices may repeat (accumulation concatenates; coalescing happens
+    once, when the gradient buffer is written).
+    """
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices      # jax (K,) int32
+        self.values = values        # jax (K, cols...)
+        self.shape = tuple(shape)   # logical dense shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        return _RowSparseCt(self.indices, self.values.astype(dtype),
+                            self.shape)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        base = jnp.zeros(self.shape, self.values.dtype)
+        return base.at[self.indices].add(self.values)
+
+    def coalesce(self):
+        """Merge duplicate indices (sorted unique + segment-sum)."""
+        import jax
+        import jax.numpy as jnp
+
+        uniq, inv = jnp.unique(self.indices, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                   num_segments=uniq.shape[0])
+        return _RowSparseCt(uniq, vals, self.shape)
+
+    def __add__(self, other):
+        import jax.numpy as jnp
+
+        if isinstance(other, _RowSparseCt):
+            return _RowSparseCt(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values, other.values]), self.shape)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+
+def _sparsify_rows(arr):
+    """Dense (R, cols...) -> (indices, values) of nonzero rows, computed
+    on device (no host round-trip of the full table)."""
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(arr)
+    mask = jnp.any(arr.reshape(arr.shape[0], -1) != 0, axis=1)
+    idx = jnp.nonzero(mask)[0].astype(jnp.int32)   # eager: concrete size
+    return idx, jnp.take(arr, idx, axis=0)
+
+
+def _sparsify_csr(a):
+    """Dense 2-D numpy -> (data, indices, indptr) numpy components."""
+    a = _np.asarray(a)
+    counts = (a != 0).sum(axis=1)
+    return (a[a != 0], _np.nonzero(a)[1].astype(_np.int32),
+            _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int32))
+
+
 class BaseSparseNDArray(NDArray):
     __slots__ = ()
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Dense-backed row_sparse array; `indices`/`data` recover components."""
+    """True compact row-sparse array: (indices, values) + logical shape.
 
-    __slots__ = ("_rs_indices",)
+    Dense ops still work — ``_data`` is a property that materializes a
+    scattered dense view on demand — but storage stays O(K) until then.
+    """
 
-    def __init__(self, data, ctx=None, indices=None):
-        super().__init__(data, ctx, stype="row_sparse")
-        self._rs_indices = indices
+    __slots__ = ("_rs_indices", "_rs_values", "_logical_shape")
 
-    @property
-    def indices(self):
+    def __init__(self, indices, values, shape, ctx=None):
         import jax.numpy as jnp
 
-        if self._rs_indices is not None:
-            return _from_jax(self._rs_indices)
-        nz = _np.nonzero(_np.abs(self.asnumpy()).reshape(
-            self.shape[0], -1).sum(axis=1))[0]
-        return _from_jax(jnp.asarray(nz.astype(_np.int64)))
+        # NDArray.__init__ not called: _data is compact-backed here
+        self._rs_indices = jnp.asarray(indices, jnp.int32)
+        self._rs_values = jnp.asarray(values)
+        self._logical_shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._stype = "row_sparse"
+
+    # -- compact accessors (no densification) ----------------------------------
+    @property
+    def indices(self):
+        return _from_jax(self._rs_indices)
 
     @property
     def data(self):
+        return _from_jax(self._rs_values)
+
+    @property
+    def num_stored_rows(self):
+        return int(self._rs_indices.shape[0])
+
+    # -- dense view ------------------------------------------------------------
+    @property
+    def _data(self):
         import jax.numpy as jnp
 
-        idx = self.indices._data
-        return _from_jax(jnp.take(self._data, idx, axis=0))
+        base = jnp.zeros(self._logical_shape, self._rs_values.dtype)
+        return base.at[self._rs_indices].add(self._rs_values)
+
+    @_data.setter
+    def _data(self, jarr):
+        self._set_data(jarr)
+
+    @property
+    def shape(self):
+        return self._logical_shape
+
+    @property
+    def dtype(self):
+        dt = self._rs_values.dtype
+        return dt.type if hasattr(dt, "type") and \
+            dt.type.__module__ == "numpy" else dt
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._logical_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._logical_shape)
+
+    def _set_data(self, jarr):
+        """Dense write-back: re-sparsify (nonzero rows, on device)."""
+        if isinstance(jarr, _RowSparseCt):
+            self._set_sparse(jarr.indices, jarr.values)
+            return
+        idx, vals = _sparsify_rows(jarr)
+        self._rs_indices = idx
+        self._rs_values = vals
+        self._logical_shape = tuple(int(s) for s in jarr.shape)
+        self._version += 1
+
+    def _set_sparse(self, indices, values):
+        import jax.numpy as jnp
+
+        self._rs_indices = jnp.asarray(indices, jnp.int32)
+        self._rs_values = jnp.asarray(values)
+        self._version += 1
 
     def tostype(self, stype):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         return self
+
+    def copy(self):
+        return RowSparseNDArray(self._rs_indices, self._rs_values,
+                                self._logical_shape, self._ctx)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._logical_shape} "
+                f"({self.num_stored_rows} stored rows)>")
 
 
 class CSRNDArray(BaseSparseNDArray):
-    __slots__ = ()
+    """Compact CSR: (data, indices, indptr) + logical shape (the I/O
+    format — LibSVMIter and scipy interop)."""
 
-    def __init__(self, data, ctx=None):
-        super().__init__(data, ctx, stype="csr")
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr",
+                 "_logical_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax.numpy as jnp
+
+        self._csr_data = jnp.asarray(data)
+        self._csr_indices = jnp.asarray(indices, jnp.int32)
+        self._csr_indptr = jnp.asarray(indptr, jnp.int32)
+        self._logical_shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._stype = "csr"
 
     @property
     def indptr(self):
-        import jax.numpy as jnp
-
-        a = self.asnumpy()
-        counts = (a != 0).sum(axis=1)
-        return _from_jax(jnp.asarray(
-            _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int64)))
+        return _from_jax(self._csr_indptr)
 
     @property
     def indices(self):
-        import jax.numpy as jnp
-
-        a = self.asnumpy()
-        return _from_jax(jnp.asarray(_np.nonzero(a)[1].astype(_np.int64)))
+        return _from_jax(self._csr_indices)
 
     @property
     def data(self):
+        return _from_jax(self._csr_data)
+
+    @property
+    def _data(self):
         import jax.numpy as jnp
 
-        a = self.asnumpy()
-        return _from_jax(jnp.asarray(a[a != 0]))
+        n_rows, n_cols = self._logical_shape
+        indptr = _np.asarray(self._csr_indptr)
+        rows = _np.repeat(_np.arange(n_rows), _np.diff(indptr))
+        base = jnp.zeros(self._logical_shape, self._csr_data.dtype)
+        return base.at[jnp.asarray(rows),
+                       self._csr_indices].set(self._csr_data)
+
+    @_data.setter
+    def _data(self, jarr):
+        self._set_data(jarr)
+
+    @property
+    def shape(self):
+        return self._logical_shape
+
+    @property
+    def dtype(self):
+        dt = self._csr_data.dtype
+        return dt.type if hasattr(dt, "type") and \
+            dt.type.__module__ == "numpy" else dt
+
+    @property
+    def size(self):
+        return self._logical_shape[0] * self._logical_shape[1]
+
+    @property
+    def ndim(self):
+        return 2
+
+    def _set_data(self, jarr):
+        import jax.numpy as jnp
+
+        a = _np.asarray(jarr)
+        data, indices, indptr = _sparsify_csr(a)
+        self._csr_data = jnp.asarray(data)
+        self._csr_indices = jnp.asarray(indices)
+        self._csr_indptr = jnp.asarray(indptr)
+        self._logical_shape = tuple(a.shape)
+        self._version += 1
 
     def tostype(self, stype):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         return self
 
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._logical_shape} "
+                f"({int(self._csr_data.shape[0])} stored values)>")
+
+
+# -- constructors --------------------------------------------------------------
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
-    import jax.numpy as jnp
-
-    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not isinstance(
-            arg1[0], (int, float)):
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 \
+            and not isinstance(arg1[0], (int, float)):
         data, indices = arg1
-        data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
+        data = _np.asarray(getattr(data, "asnumpy", lambda: data)(),
+                           dtype=dtype)
         indices = _np.asarray(
-            getattr(indices, "asnumpy", lambda: indices)()).astype(_np.int64)
-        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:]
-                               if len(indices) else (0,) + data.shape[1:])
-        dense = _np.zeros(full_shape, dtype=dtype or data.dtype)
-        dense[indices] = data
-        return RowSparseNDArray(jnp.asarray(dense),
-                                indices=jnp.asarray(indices))
-    a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)(),
-                    dtype=dtype or "float32")
-    return RowSparseNDArray(jnp.asarray(a))
+            getattr(indices, "asnumpy", lambda: indices)()).astype(
+            _np.int64)
+        full_shape = shape or (
+            ((int(indices.max()) + 1,) + data.shape[1:]) if len(indices)
+            else (0,) + data.shape[1:])
+        return RowSparseNDArray(indices, data, full_shape, ctx)
+    # dense input: sparsify (on device when already an NDArray)
+    raw = arg1._data if isinstance(arg1, NDArray) else _np.asarray(
+        arg1, dtype=dtype or "float32")
+    idx, vals = _sparsify_rows(raw)
+    return RowSparseNDArray(idx, vals, raw.shape, ctx)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    import jax.numpy as jnp
-
+    if isinstance(arg1, CSRNDArray):
+        return arg1
     if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
         data, indices, indptr = (
             _np.asarray(getattr(x, "asnumpy", lambda x=x: x)())
             for x in arg1)
         n_rows = len(indptr) - 1
         n_cols = shape[1] if shape else int(indices.max()) + 1
-        dense = _np.zeros((n_rows, n_cols), dtype=dtype or data.dtype)
-        for r in range(n_rows):
-            for j in range(int(indptr[r]), int(indptr[r + 1])):
-                dense[r, int(indices[j])] = data[j]
-        return CSRNDArray(jnp.asarray(dense))
+        return CSRNDArray(data.astype(dtype or data.dtype), indices,
+                          indptr, (n_rows, n_cols), ctx)
     a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)(),
                     dtype=dtype or "float32")
-    return CSRNDArray(jnp.asarray(a))
+    data, indices, indptr = _sparsify_csr(a)
+    return CSRNDArray(data, indices, indptr, a.shape, ctx)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
     from . import zeros as dense_zeros
 
-    base = dense_zeros(shape, ctx, dtype)
+    dtype = dtype or "float32"
     if stype == "row_sparse":
-        return RowSparseNDArray(base._data, base._ctx)
+        return RowSparseNDArray(
+            jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,) + tuple(shape[1:]), dtype), shape, ctx)
     if stype == "csr":
-        return CSRNDArray(base._data, base._ctx)
-    return base
+        return CSRNDArray(jnp.zeros((0,), dtype),
+                          jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape,
+                          ctx)
+    return dense_zeros(shape, ctx, dtype)

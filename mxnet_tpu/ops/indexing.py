@@ -43,9 +43,51 @@ def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
     # Gather rows of the table; on TPU this is a dynamic-gather the compiler
-    # handles well.  sparse_grad is accepted for API parity (XLA's scatter-add
-    # transpose already gives the row-sparse-like update).
+    # handles well.  Under jit, sparse_grad needs no special handling: XLA's
+    # scatter-add transpose of the gather IS the fused row update.  The
+    # eager compact-gradient path (O(touched rows) buffers) lives in
+    # sparse_embedding below.
     return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+def sparse_embedding(data, weight):
+    """Eager Embedding whose weight gradient is a compact row-sparse
+    cotangent — O(touched rows) device memory, the reference's
+    sparse_grad=True path (src/operator/tensor/indexing_op.cc backward
+    with req=kWriteTo on a row_sparse grad).
+
+    data/weight: NDArrays.  Must run outside jit (the tape is eager by
+    definition); inside jit the dense path above is already optimal.
+    """
+    from .. import autograd as _ag
+    from ..ndarray.ndarray import _from_jax
+    from ..ndarray.sparse import _RowSparseCt
+
+    class _Fn(_ag.Function):
+        def forward(self, data, weight):
+            self._wshape = tuple(weight.shape)
+            self._wdtype = weight._data.dtype
+            # clip ONCE and reuse in backward: scattering at raw ids
+            # would misroute out-of-range gradients (e.g. -1 lands on
+            # the last row) while the forward read the clamped row
+            self._ids = jnp.clip(data._data.astype(jnp.int32), 0,
+                                 self._wshape[0] - 1)
+            return _from_jax(jnp.take(weight._data, self._ids, axis=0))
+
+        def backward(self, g):
+            import jax
+
+            ids = self._ids.reshape(-1)
+            cols = self._wshape[1:]
+            gv = g._data.reshape((-1,) + cols)
+            # coalesce at the op so downstream accumulation stays small
+            uniq, inv = jnp.unique(ids, return_inverse=True)
+            vals = jax.ops.segment_sum(
+                gv.astype(jnp.float32), inv.reshape(-1),
+                num_segments=uniq.shape[0]).astype(self._wdtype)
+            return None, _RowSparseCt(uniq, vals, self._wshape)
+
+    return _Fn()(data, weight)
 
 
 @register("gather_nd")

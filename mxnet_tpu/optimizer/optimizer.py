@@ -205,7 +205,35 @@ class Optimizer:
         return kw
 
     def _apply(self, pure_fn, weight, states, grad, **kwargs):
-        """Run a fused pure update; swap results into weight/state handles."""
+        """Run a fused pure update; swap results into weight/state handles.
+
+        Row-sparse gradients take the reference's lazy_update path: the
+        SAME fused update runs on just the touched rows (every fused
+        update here is elementwise, so row decomposition is exact), then
+        scatters back — O(touched rows) compute and memory, untouched
+        rows (and their states) never move."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) \
+                and not getattr(self, "lazy_update", True):
+            # explicit lazy_update=False: reference semantics are a full
+            # dense update (momentum decays / wd applies on ALL rows)
+            grad = grad.tostype("default")
+        if isinstance(grad, RowSparseNDArray):
+            import jax.numpy as jnp
+
+            idx = grad._rs_indices
+            gv = grad._rs_values
+            w = _raw(weight)
+            w_rows = jnp.take(w, idx, axis=0)
+            s_raws = [_raw(s) for s in states]
+            s_rows = [jnp.take(s, idx, axis=0) for s in s_raws]
+            res = pure_fn(w_rows, gv.astype(w_rows.dtype), *s_rows,
+                          **kwargs)
+            weight._set_data(w.at[idx].set(res[0]))
+            for s, s_raw, new in zip(states, s_raws, res[1:]):
+                s._set_data(s_raw.at[idx].set(new))
+            return
         res = pure_fn(_raw(weight), _raw(grad),
                       *[_raw(s) for s in states], **kwargs)
         weight._set_data(res[0])

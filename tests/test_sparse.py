@@ -1,0 +1,249 @@
+"""Real sparse storage tests (VERDICT r2 Missing #4 / task: row_sparse
+with sparse gradient flow).
+
+Reference parity: tests/python/unittest/test_sparse_ndarray.py +
+test_sparse_operator.py — the invariant under test is the one that
+matters: gradient/storage buffers are O(touched rows), never O(table).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray.sparse import (CSRNDArray, RowSparseNDArray,
+                                      csr_matrix, row_sparse_array)
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def test_row_sparse_compact_storage():
+    vals = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([1, 5, 98])
+    a = row_sparse_array((vals, idx), shape=(100, 4))
+    # storage is the compact parts, not a dense (100, 4) buffer
+    assert a.num_stored_rows == 3
+    assert a._rs_values.shape == (3, 4)
+    assert a.shape == (100, 4)
+    np.testing.assert_array_equal(a.indices.asnumpy(), idx)
+    np.testing.assert_array_equal(a.data.asnumpy(), vals)
+    dense = a.tostype("default")
+    assert dense.shape == (100, 4)
+    np.testing.assert_array_equal(dense.asnumpy()[idx], vals)
+    assert dense.asnumpy().sum() == vals.sum()
+    # dense -> sparse round trip
+    back = dense.tostype("row_sparse")
+    assert isinstance(back, RowSparseNDArray)
+    assert back.num_stored_rows == 3
+    np.testing.assert_array_equal(back.asnumpy(), a.asnumpy())
+
+
+def test_row_sparse_dense_ops_work():
+    a = row_sparse_array((np.ones((2, 3), np.float32), [0, 4]),
+                         shape=(6, 3))
+    s = (a * 2).asnumpy()
+    assert s.sum() == 12.0
+
+
+def test_csr_compact_storage():
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([0, 2, 1])
+    indptr = np.array([0, 2, 2, 3])
+    a = csr_matrix((data, indices, indptr), shape=(3, 4))
+    assert isinstance(a, CSRNDArray)
+    assert a._csr_data.shape == (3,)
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    np.testing.assert_array_equal(a.asnumpy(), expect)
+    np.testing.assert_array_equal(a.indptr.asnumpy(), indptr)
+    # dense -> csr
+    b = nd.array(expect).tostype("csr")
+    np.testing.assert_array_equal(b.data.asnumpy(), data)
+    np.testing.assert_array_equal(b.asnumpy(), expect)
+
+
+def test_sparse_zeros():
+    z = sp.zeros("row_sparse", (50, 8))
+    assert z.num_stored_rows == 0
+    assert z.shape == (50, 8)
+    assert z.asnumpy().sum() == 0
+
+
+def test_embedding_sparse_grad_is_compact():
+    """The headline invariant: a 10k-row table touched by 4 distinct ids
+    yields a gradient holding exactly 4 rows."""
+    from mxnet_tpu.gluon import nn
+
+    vocab, dim = 10000, 8
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize(init=mx.init.Xavier())
+    ids = nd.array(np.array([[3, 77, 3], [500, 9999, 77]], np.float32))
+    with autograd.record():
+        out = emb(ids)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g.num_stored_rows == 4          # {3, 77, 500, 9999} coalesced
+    assert g._rs_values.shape == (4, dim)  # O(touched), not O(vocab)
+    # values match the dense autograd path
+    emb_d = nn.Embedding(vocab, dim, sparse_grad=False)
+    emb_d.initialize(init=mx.init.Xavier())
+    emb_d.weight.set_data(emb.weight.data())
+    with autograd.record():
+        out = emb_d(ids)
+        loss = (out * out).sum()
+    loss.backward()
+    gd = emb_d.weight.grad().asnumpy()
+    np.testing.assert_allclose(g.asnumpy(), gd, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_sgd_lazy_update_touches_only_rows():
+    """Optimizer lazy path: untouched rows (weight AND momentum state)
+    must be bit-identical after the update."""
+    rng = np.random.RandomState(0)
+    vocab, dim = 200, 4
+    w0 = rng.randn(vocab, dim).astype(np.float32)
+    weight = nd.array(w0.copy())
+    idx = np.array([7, 42])
+    gvals = rng.randn(2, dim).astype(np.float32)
+    grad = row_sparse_array((gvals, idx), shape=(vocab, dim))
+
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, wd=0.0)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+
+    w1 = weight.asnumpy()
+    untouched = np.setdiff1d(np.arange(vocab), idx)
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    np.testing.assert_allclose(w1[idx], w0[idx] - 0.5 * gvals,
+                               rtol=1e-6)
+    mom = state.asnumpy()
+    assert np.all(mom[untouched] == 0)
+    assert np.any(mom[idx] != 0)
+
+
+def test_sparse_adam_matches_dense_on_touched_rows():
+    rng = np.random.RandomState(1)
+    vocab, dim = 50, 3
+    w0 = rng.randn(vocab, dim).astype(np.float32)
+    idx = np.array([2, 30])
+    gvals = rng.randn(2, dim).astype(np.float32)
+
+    w_sp = nd.array(w0.copy())
+    opt_sp = mx.optimizer.Adam(learning_rate=0.01)
+    st_sp = opt_sp.create_state(0, w_sp)
+    opt_sp.update(0, w_sp, row_sparse_array((gvals, idx),
+                                            shape=(vocab, dim)), st_sp)
+
+    # dense reference on the same rows: adam on rows with zero grad
+    # still moves them (dense semantics) — compare touched rows only
+    w_d = nd.array(w0.copy())
+    gd = np.zeros((vocab, dim), np.float32)
+    gd[idx] = gvals
+    opt_d = mx.optimizer.Adam(learning_rate=0.01)
+    st_d = opt_d.create_state(0, w_d)
+    opt_d.update(0, w_d, nd.array(gd), st_d)
+
+    np.testing.assert_allclose(w_sp.asnumpy()[idx], w_d.asnumpy()[idx],
+                               rtol=1e-5, atol=1e-7)
+    untouched = np.setdiff1d(np.arange(vocab), idx)
+    np.testing.assert_array_equal(w_sp.asnumpy()[untouched],
+                                  w0[untouched])
+
+
+def test_end_to_end_sparse_embedding_training():
+    """Eager training loop: Embedding(sparse_grad=True) + Trainer-style
+    updates move only touched rows and still learn."""
+    from mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(2)
+    vocab, dim = 1000, 4
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize(init=mx.init.Xavier())
+    w_before = emb.weight.data().asnumpy().copy()
+    opt = mx.optimizer.SGD(learning_rate=0.2)
+    state = opt.create_state(0, emb.weight.data())
+    target = nd.array(rng.randn(2, 3, dim).astype(np.float32))
+    ids = nd.array(np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            out = emb(ids)
+            loss = ((out - target) ** 2).sum()
+        loss.backward()
+        opt.update(0, emb.weight.data(), emb.weight.grad(), state)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.5
+    w_after = emb.weight.data().asnumpy()
+    untouched = np.setdiff1d(np.arange(vocab), np.arange(1, 7))
+    np.testing.assert_array_equal(w_after[untouched],
+                                  w_before[untouched])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    table = np.arange(40, dtype=np.float32).reshape(10, 4)
+    kv.init(0, nd.array(table))
+    out = sp.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull(0, out=out, row_ids=nd.array([2.0, 7.0, 2.0]))
+    assert isinstance(out, RowSparseNDArray)
+    assert out.num_stored_rows == 2        # deduplicated
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2, 7])
+    np.testing.assert_array_equal(out.data.asnumpy(), table[[2, 7]])
+
+
+def test_sparse_embedding_clips_out_of_range_ids():
+    """Backward must scatter at the same CLIPPED ids the forward read:
+    id -1 reads row 0 so its gradient belongs to row 0, not the last
+    row; id >= vocab belongs to the last row."""
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(init=mx.init.Xavier())
+    ids = nd.array(np.array([[-1.0, 12.0]], np.float32))
+    with autograd.record():
+        loss = emb(ids).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    np.testing.assert_array_equal(np.sort(g.indices.asnumpy()), [0, 9])
+    dense = g.asnumpy()
+    np.testing.assert_allclose(dense[0], np.ones(4))
+    np.testing.assert_allclose(dense[9], np.ones(4))
+
+
+def test_sparse_sgd_lazy_update_false_is_dense():
+    """lazy_update=False must run the full dense update: weight decay
+    applies to untouched rows too (reference semantics)."""
+    rng = np.random.RandomState(3)
+    vocab, dim = 20, 3
+    w0 = rng.randn(vocab, dim).astype(np.float32)
+    weight = nd.array(w0.copy())
+    grad = row_sparse_array(
+        (rng.randn(1, dim).astype(np.float32), [4]), shape=(vocab, dim))
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, lazy_update=False)
+    opt.update(0, weight, grad, opt.create_state(0, weight))
+    w1 = weight.asnumpy()
+    # untouched row 0 still decayed: w1 = w0 - lr*wd*w0
+    np.testing.assert_allclose(w1[0], w0[0] * (1 - 0.1 * 0.1),
+                               rtol=1e-5)
+
+
+def test_grad_req_add_accumulates_sparse():
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(100, 4, sparse_grad=True)
+    emb.initialize(init=mx.init.Xavier())
+    emb.weight.grad_req = "add"
+    ids1 = nd.array(np.array([[1, 2]], np.float32))
+    ids2 = nd.array(np.array([[2, 3]], np.float32))
+    for ids in (ids1, ids2):
+        with autograd.record():
+            loss = emb(ids).sum()
+        loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g.num_stored_rows == 3          # {1, 2, 3}
+    dense = g.asnumpy()
+    np.testing.assert_allclose(dense[2], 2 * np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(dense[1], np.ones(4), rtol=1e-6)
