@@ -178,3 +178,94 @@ class NativePrefetcher:
 
 def available():
     return lib() is not None
+
+
+# libmxtpu_img.so loads independently: a host without libjpeg keeps the
+# recordio/prefetch fast path
+_IMG_LIB = None
+_IMG_TRIED = False
+
+
+def img_lib():
+    global _IMG_LIB, _IMG_TRIED
+    if _IMG_TRIED:
+        return _IMG_LIB
+    _IMG_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in (os.path.join(here, "src", "libmxtpu_img.so"),
+                 os.path.join(here, "libmxtpu_img.so")):
+        if os.path.exists(path):
+            try:
+                L = ctypes.CDLL(path)
+                c = ctypes
+                L.MXTPUHasJpeg.restype = c.c_int
+                L.MXTPUImageDecodeAugment.restype = c.c_int
+                L.MXTPUImageDecodeAugment.argtypes = [
+                    c.POINTER(c.c_char_p), c.POINTER(c.c_size_t),
+                    c.c_int, c.c_int, c.c_int, c.c_int,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_uint64),
+                    c.POINTER(c.c_uint8), c.c_float,
+                    c.POINTER(c.c_float), c.POINTER(c.c_float), c.c_int,
+                    c.POINTER(c.c_float), c.POINTER(c.c_int32)]
+                _IMG_LIB = L
+                break
+            except OSError:
+                _IMG_LIB = None
+    return _IMG_LIB
+
+
+def has_jpeg():
+    L = img_lib()
+    return bool(L is not None and L.MXTPUHasJpeg())
+
+
+def decode_augment_batch(payloads, out, resize_short=-1, crop_modes=None,
+                         seeds=None, mirror=None, scale=1.0, mean=None,
+                         std=None, n_threads=4):
+    """Batch JPEG decode + augment into ``out`` (N, 3, H, W) float32.
+
+    Reference: iter_image_recordio_2.cc's threaded decode+augment loop.
+    crop_modes per image: -1 center, -2 random (seeded by seeds).
+    Returns a numpy int32 status array (1 decoded, 0 = caller must fall
+    back, e.g. PNG payloads).
+    """
+    import numpy as np
+
+    L = img_lib()
+    if L is None:
+        raise OSError("native jpeg path not built (make -C src)")
+    n = len(payloads)
+    # hard checks, not asserts: a shape mismatch here is an
+    # out-of-bounds C write, and python -O strips asserts
+    if not (out.ndim == 4 and out.shape[0] == n and out.shape[1] == 3
+            and out.dtype == np.float32 and out.flags.c_contiguous):
+        raise ValueError(
+            f"out must be C-contiguous float32 (n={n}, 3, H, W); got "
+            f"{out.dtype} {out.shape}")
+    c = ctypes
+    # bytes are immutable: pass their buffers by pointer, no copy (the
+    # payloads list keeps them alive for this synchronous call)
+    payloads = [bytes(p) for p in payloads]
+    ptrs = (c.c_char_p * n)(*payloads)
+    sizes = (c.c_size_t * n)(*[len(p) for p in payloads])
+    cm = np.full(n, -1, np.int32) if crop_modes is None \
+        else np.asarray(crop_modes, np.int32)
+    sd = np.zeros(n, np.uint64) if seeds is None \
+        else np.asarray(seeds, np.uint64)
+    mr = np.zeros(n, np.uint8) if mirror is None \
+        else np.asarray(mirror, np.uint8)
+    mean = np.asarray(mean if mean is not None else [0, 0, 0],
+                      np.float32)
+    std = np.asarray(std if std is not None else [1, 1, 1], np.float32)
+    status = np.zeros(n, np.int32)
+    L.MXTPUImageDecodeAugment(
+        ptrs, sizes, n, int(resize_short), int(out.shape[2]),
+        int(out.shape[3]),
+        cm.ctypes.data_as(c.POINTER(c.c_int32)),
+        sd.ctypes.data_as(c.POINTER(c.c_uint64)),
+        mr.ctypes.data_as(c.POINTER(c.c_uint8)),
+        float(scale), mean.ctypes.data_as(c.POINTER(c.c_float)),
+        std.ctypes.data_as(c.POINTER(c.c_float)), int(n_threads),
+        out.ctypes.data_as(c.POINTER(c.c_float)),
+        status.ctypes.data_as(c.POINTER(c.c_int32)))
+    return status

@@ -589,6 +589,7 @@ class ImageRecordIter(DataIter):
                              dtype=_np.float32).reshape(3, 1, 1)
         self.scale = scale
         self.resize = resize
+        self.preprocess_threads = preprocess_threads
         self._img = img_mod
         # index pass: record OFFSETS only (payloads stream per batch — the
         # reference's parser also reads chunks on demand, iter_image_
@@ -655,7 +656,23 @@ class ImageRecordIter(DataIter):
         self.cursor = n
         return idx
 
+    def _decode_one(self, payload, mirror_flag):
+        """Python/PIL fallback for one image -> normalized CHW."""
+        _, h, w = self.data_shape
+        arr = self._img.imdecode_np(payload)  # HWC uint8
+        if self.resize > 0:
+            arr = self._img.resize_short_np(arr, self.resize)
+        if self.rand_crop:
+            arr = self._img.random_crop_np(arr, (w, h))
+        else:
+            arr = self._img.center_crop_np(arr, (w, h))
+        if mirror_flag:
+            arr = arr[:, ::-1, :]
+        chw = arr.astype(_np.float32).transpose(2, 0, 1)
+        return (chw * self.scale - self.mean) / self.std
+
     def next(self):
+        from .. import _native
         from .. import recordio as rio
 
         idx = self._next_indices()
@@ -663,24 +680,42 @@ class ImageRecordIter(DataIter):
         data = _np.empty((self.batch_size, c, h, w), dtype=_np.float32)
         label = _np.empty((self.batch_size, self.label_width),
                           dtype=_np.float32)
+        payloads = []
         for i in range(self.batch_size):
             rec = self._read_at(self._offsets[idx[i]])
             header, payload = rio.unpack(rec)
-            arr = self._img.imdecode_np(payload)  # HWC uint8
-            if self.resize > 0:
-                arr = self._img.resize_short_np(arr, self.resize)
-            if self.rand_crop:
-                arr = self._img.random_crop_np(arr, (w, h))
-            else:
-                arr = self._img.center_crop_np(arr, (w, h))
-            if self.rand_mirror and _np.random.rand() < 0.5:
-                arr = arr[:, ::-1, :]
-            chw = arr.astype(_np.float32).transpose(2, 0, 1)
-            chw = (chw * self.scale - self.mean) / self.std
-            data[i] = chw
+            payloads.append(payload)
             lab = header.label
             label[i] = lab if _np.ndim(lab) else [lab] * self.label_width
-        self.cursor += self.batch_size
+        # randomness drawn HERE (one RNG, seed semantics stay in python);
+        # the native kernel is pure given crop seeds + mirror flags
+        mirror = (_np.random.rand(self.batch_size) < 0.5) \
+            if self.rand_mirror else _np.zeros(self.batch_size, bool)
+        if _native.has_jpeg() and c == 3:
+            # native fast path: threaded libjpeg decode + fused augment
+            # (reference: iter_image_recordio_2.cc + image_aug_default.cc)
+            crop_modes = _np.full(self.batch_size,
+                                  -2 if self.rand_crop else -1, _np.int32)
+            # draw seeds only when used: center-crop eval runs must not
+            # perturb the global RNG stream vs the python fallback
+            seeds = _np.random.randint(
+                0, 2 ** 62, self.batch_size).astype(_np.uint64) \
+                if self.rand_crop else _np.zeros(self.batch_size,
+                                                 _np.uint64)
+            status = _native.decode_augment_batch(
+                payloads, data, resize_short=self.resize,
+                crop_modes=crop_modes, seeds=seeds,
+                mirror=mirror.astype(_np.uint8), scale=self.scale,
+                mean=self.mean.reshape(3), std=self.std.reshape(3),
+                n_threads=self.preprocess_threads)
+            for i in _np.nonzero(status == 0)[0]:
+                # non-JPEG payloads (e.g. PNG): python codec fallback
+                data[i] = self._decode_one(payloads[i], mirror[i])
+        else:
+            for i in range(self.batch_size):
+                data[i] = self._decode_one(payloads[i], mirror[i])
+        # cursor was already advanced by _next_indices — advancing here
+        # too skipped every other batch of the epoch
         return DataBatch(
             data=[_array(data)],
             label=[_array(label[:, 0] if self.label_width == 1 else label)],

@@ -19,6 +19,15 @@ class Feature:
         return f"[{'✔' if self.enabled else '✖'} {self.name}]"
 
 
+def _has_native_jpeg():
+    try:
+        from . import _native
+
+        return _native.has_jpeg()
+    except Exception:
+        return False
+
+
 def _detect():
     import jax
 
@@ -44,7 +53,9 @@ def _detect():
         "INT64_TENSOR_SIZE": True,
         # IO / formats
         "OPENCV": False,       # PIL-based codecs instead
-        "JPEG_TURBO": False,   # planned: native C++ decode path
+        # native threaded libjpeg decode+augment (src/image_decode.cc);
+        # honest: probed from the built library, False when unbuilt
+        "JPEG_TURBO": _has_native_jpeg(),
         "RECORDIO": True,
         # distributed
         "DIST_KVSTORE": True,  # jax.distributed + collectives

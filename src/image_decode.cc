@@ -1,0 +1,233 @@
+// Native JPEG decode + default augmenter pipeline.
+//
+// Reference parity: src/io/iter_image_recordio_2.cc's OMP decode loop +
+// src/io/image_aug_default.cc (resize-short / crop / mirror /
+// mean-std normalize), rebuilt as a flat C entry on a fork-join thread
+// pool.  libjpeg-turbo does the codec work; augmentation is fused into
+// the decode pass so each image is touched once and written straight
+// into the caller's (N, 3, H, W) float batch — the layout the training
+// step consumes.
+//
+// Randomness (crop origin, mirror) comes from the CALLER: python draws
+// per-image seeds/flags so seed semantics live in one place and this
+// kernel stays pure.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jmp;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+bool DecodeJpeg(const uint8_t* buf, size_t len, int min_short_side,
+                std::vector<uint8_t>* out, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  if (min_short_side > 0) {
+    // libjpeg's M/8 scaled decode: pick the smallest scale that still
+    // covers the resize target — decode cost drops with pixel count
+    // (the trick behind the reference pipeline's decode throughput)
+    const int short_side = std::min(cinfo.image_width,
+                                    cinfo.image_height);
+    int num = 8;
+    while (num > 1 && short_side * (num - 1) / 8 >= min_short_side)
+      --num;
+    cinfo.scale_num = num;
+    cinfo.scale_denom = 8;
+  }
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize HWC uint8, half-pixel-center sampling (the OpenCV
+// INTER_LINEAR convention the reference's augmenter uses; PIL's
+// filtered bilinear differs slightly on downscale — both are valid,
+// the python fallback keeps PIL)
+void ResizeBilinear(const std::vector<uint8_t>& src, int sh, int sw,
+                    int dh, int dw, std::vector<uint8_t>* dst) {
+  dst->resize(static_cast<size_t>(dh) * dw * 3);
+  const float ry = static_cast<float>(sh) / dh;
+  const float rx = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = std::max((y + 0.5f) * ry - 0.5f, 0.f);
+    const int y0 = static_cast<int>(fy);
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = std::max((x + 0.5f) * rx - 0.5f, 0.f);
+      const int x0 = static_cast<int>(fx);
+      const int x1 = std::min(x0 + 1, sw - 1);
+      const float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        const float v00 = src[(static_cast<size_t>(y0) * sw + x0) * 3 + c];
+        const float v01 = src[(static_cast<size_t>(y0) * sw + x1) * 3 + c];
+        const float v10 = src[(static_cast<size_t>(y1) * sw + x0) * 3 + c];
+        const float v11 = src[(static_cast<size_t>(y1) * sw + x1) * 3 + c];
+        const float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                        v10 * wy * (1 - wx) + v11 * wy * wx;
+        (*dst)[(static_cast<size_t>(y) * dw + x) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// one image: decode -> resize-short -> crop -> mirror -> normalized CHW
+bool ProcessOne(const uint8_t* payload, size_t size, int resize_short,
+                int out_h, int out_w, int32_t crop_mode, uint64_t seed,
+                bool mirror, float scale, const float* mean,
+                const float* stdv, float* out) {
+  std::vector<uint8_t> img;
+  int h = 0, w = 0;
+  // scaled decode ONLY when a resize follows (the resample blends away
+  // the scale); without resize the crop must see the full-res image
+  if (!DecodeJpeg(payload, size, resize_short, &img, &h, &w))
+    return false;
+
+  std::vector<uint8_t> tmp;
+  if (resize_short > 0) {
+    // floor division matches image.py resize_short_np exactly
+    int dh, dw;
+    if (h > w) {
+      dh = static_cast<int>(
+          static_cast<int64_t>(resize_short) * h / w);
+      dw = resize_short;
+    } else {
+      dh = resize_short;
+      dw = static_cast<int>(
+          static_cast<int64_t>(resize_short) * w / h);
+    }
+    if (dh != h || dw != w) {
+      ResizeBilinear(img, h, w, dh, dw, &tmp);
+      img.swap(tmp);
+      h = dh;
+      w = dw;
+    }
+  }
+  // crop semantics match image.py center_crop_np/random_crop_np: the
+  // crop window is clamped per-dimension (min(target, dim)) and the
+  // CROPPED PATCH is then resized to the target if any dim fell short —
+  // an undersized dim stretches, an oversized dim still crops
+  const int ch = std::min(h, out_h), cw = std::min(w, out_w);
+  int cy, cx;
+  if (crop_mode == -2) {  // random crop, caller-seeded
+    std::mt19937_64 rng(seed);
+    cy = h == ch ? 0 : static_cast<int>(rng() % (h - ch + 1));
+    cx = w == cw ? 0 : static_cast<int>(rng() % (w - cw + 1));
+  } else {  // center
+    cy = (h - ch) / 2;
+    cx = (w - cw) / 2;
+  }
+  if (ch != out_h || cw != out_w) {
+    std::vector<uint8_t> patch(static_cast<size_t>(ch) * cw * 3);
+    for (int y = 0; y < ch; ++y)
+      std::memcpy(patch.data() + static_cast<size_t>(y) * cw * 3,
+                  img.data() + (static_cast<size_t>(cy + y) * w + cx) * 3,
+                  static_cast<size_t>(cw) * 3);
+    ResizeBilinear(patch, ch, cw, out_h, out_w, &tmp);
+    img.swap(tmp);
+    h = out_h;
+    w = out_w;
+    cy = cx = 0;
+  }
+  const float inv_std[3] = {1.f / stdv[0], 1.f / stdv[1], 1.f / stdv[2]};
+  for (int y = 0; y < out_h; ++y) {
+    const uint8_t* row =
+        img.data() + (static_cast<size_t>(cy + y) * w + cx) * 3;
+    for (int x = 0; x < out_w; ++x) {
+      const int sx = mirror ? (out_w - 1 - x) : x;
+      for (int c = 0; c < 3; ++c) {
+        out[(static_cast<size_t>(c) * out_h + y) * out_w + x] =
+            (row[sx * 3 + c] * scale - mean[c]) * inv_std[c];
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe: 1 when the build carries the libjpeg decode path.
+int MXTPUHasJpeg() { return 1; }
+
+// Decode+augment a batch into out (n, 3, out_h, out_w) float32.
+// crop_mode per image: -1 center, -2 random (seeded by seeds[i]).
+// status per image: 1 decoded, 0 failed (caller falls back).
+// Returns the number of failures.
+int MXTPUImageDecodeAugment(const uint8_t* const* payloads,
+                            const size_t* sizes, int n, int resize_short,
+                            int out_h, int out_w,
+                            const int32_t* crop_modes,
+                            const uint64_t* seeds, const uint8_t* mirror,
+                            float scale, const float* mean,
+                            const float* stdv, int nthreads, float* out,
+                            int32_t* status) {
+  const size_t img_elems = static_cast<size_t>(3) * out_h * out_w;
+  nthreads = std::max(1, std::min(nthreads, n));
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) {
+    workers.emplace_back([&, t]() {
+      for (int i = t; i < n; i += nthreads) {
+        status[i] = ProcessOne(payloads[i], sizes[i], resize_short,
+                               out_h, out_w, crop_modes[i], seeds[i],
+                               mirror[i] != 0, scale, mean, stdv,
+                               out + i * img_elems)
+                        ? 1
+                        : 0;
+      }
+    });
+  }
+  for (auto& th : workers) th.join();
+  int failures = 0;
+  for (int i = 0; i < n; ++i) failures += status[i] == 0;
+  return failures;
+}
+
+}  // extern "C"

@@ -22,8 +22,23 @@ if "xla_force_host_platform_device_count" not in _flags:
 # tunnel claim entirely (a stale claim otherwise hangs jax init).
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import shutil
+import subprocess
+
 import numpy as np
 import pytest
+
+# Build the native libs once per session if the toolchain exists — a
+# fresh checkout carries no .so, and the native paths (recordio codec,
+# jpeg decode, C API) should be exercised, not silently skipped.
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+if shutil.which("make") and shutil.which("g++"):
+    _missing = [n for n in ("libmxtpu_io.so", "libmxtpu_img.so",
+                            "libmxtpu.so")
+                if not os.path.exists(os.path.join(_SRC, n))]
+    if _missing:
+        subprocess.run(["make", "-C", _SRC], capture_output=True)
 
 
 @pytest.fixture(autouse=True)

@@ -81,6 +81,97 @@ def test_csviter(tmp_path):
                                rtol=1e-5)
 
 
+def _make_rec(tmp_path, n=8, size=(32, 48), fmt="jpeg"):
+    """Synthesize a .rec of n images with labels 0..n-1."""
+    import io as _io
+
+    from PIL import Image
+
+    path = str(tmp_path / f"imgs_{fmt}.rec")
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    raws = []
+    for i in range(n):
+        arr = rng.randint(0, 255, size + (3,)).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format=fmt)
+        payload = buf.getvalue()
+        raws.append(payload)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write(recordio.pack(header, payload))
+    w.close()
+    return path, raws
+
+
+def test_image_record_iter_native_decode(tmp_path):
+    """Native libjpeg batch path: bit-identical to the PIL fallback when
+    no resize is involved (same libjpeg decode, same crop/normalize
+    math); close under resize (native = OpenCV-convention bilinear like
+    the reference, PIL = filtered bilinear).  Labels must pair up across
+    multiple batches."""
+    from mxnet_tpu import _native
+    from mxnet_tpu.io import ImageRecordIter
+
+    # exact path: images bigger than crop, no resize
+    path, _ = _make_rec(tmp_path, n=6, size=(40, 56))
+    kw = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=3,
+              mean_r=0.3, std_r=1.1, scale=1 / 255.0)
+    it = ImageRecordIter(**kw)
+    batches = [it.next() for _ in range(2)]
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    np.testing.assert_array_equal(np.sort(labels), np.arange(6))
+    if _native.has_jpeg():
+        it2 = ImageRecordIter(**kw)
+        got = it2.next().data[0].asnumpy()
+        py = np.stack([
+            it2._decode_one(p, False)
+            for p in _collect_payloads(path)[:3]])
+        np.testing.assert_allclose(got, py, atol=1e-6)
+        # resize path: algorithms differ by design; catch gross errors
+        it3 = ImageRecordIter(resize=36, **kw)
+        got3 = it3.next().data[0].asnumpy()
+        py3 = np.stack([
+            it3._decode_one(p, False)
+            for p in _collect_payloads(path)[:3]])
+        # noise images are the worst case for filter differences;
+        # this bounds gross errors (wrong crop/channel order would be
+        # >0.2 mean), not codec agreement
+        assert np.mean(np.abs(got3 - py3)) < 10 / 255
+
+
+def _collect_payloads(path):
+    r = recordio.MXRecordIO(path, "r")
+    out = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        out.append(recordio.unpack(rec)[1])
+    return out
+
+
+def test_image_record_iter_png_fallback(tmp_path):
+    """PNG payloads can't go through libjpeg — the per-image python
+    fallback must kick in transparently."""
+    from mxnet_tpu.io import ImageRecordIter
+
+    path, _ = _make_rec(tmp_path, n=4, size=(32, 32), fmt="png")
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=4)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert np.isfinite(b.data[0].asnumpy()).all()
+
+
+def test_native_jpeg_feature_flag():
+    """runtime.Features JPEG_TURBO must reflect the built library."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+
+    feats = mx.runtime.Features()
+    assert feats["JPEG_TURBO"].enabled == _native.has_jpeg()
+
+
 def test_recordio_roundtrip(tmp_path):
     path = str(tmp_path / "test.rec")
     writer = recordio.MXRecordIO(path, "w")

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput microbench.
+
+Reference parity: the role of tools/bandwidth + the perf tables for
+iter_image_recordio_2.cc — proves the decode+augment path can feed the
+chip faster than the training step consumes (BASELINE: the honest
+ResNet-50 samples/sec/chip number).
+
+Synthesizes a .rec of ImageNet-sized JPEGs, then measures images/sec
+through ImageRecordIter for the native libjpeg path and the PIL
+fallback.  Prints one JSON line.
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def synth_rec(path, n=256, size=(360, 480)):
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    # smooth-ish synthetic images compress/decode like photos
+    base = rng.randint(0, 255, (size[0] // 8, size[1] // 8, 3))
+    img = np.kron(base, np.ones((8, 8, 1))).astype(np.uint8)
+    for i in range(n):
+        buf = io.BytesIO()
+        Image.fromarray(np.roll(img, i, axis=1)).save(
+            buf, format="jpeg", quality=90)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+
+def run(path, n, batch_size, force_python=False):
+    from mxnet_tpu import _native
+    from mxnet_tpu.io import ImageRecordIter
+
+    it = ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=batch_size,
+        resize=256, rand_crop=True, rand_mirror=True, scale=1 / 255.0,
+        preprocess_threads=int(os.environ.get("BENCH_IO_THREADS",
+                                              os.cpu_count() or 4)))
+    if force_python:
+        has = _native.has_jpeg
+        _native.has_jpeg = lambda: False
+    try:
+        it.next()  # warm
+        it.reset()
+        t0 = time.perf_counter()
+        count = 0
+        for _ in range(n // batch_size):
+            try:
+                b = it.next()
+            except StopIteration:
+                it.reset()
+                b = it.next()
+            count += b.data[0].shape[0]
+        dt = time.perf_counter() - t0
+    finally:
+        if force_python:
+            _native.has_jpeg = has
+    return count / dt
+
+
+def main():
+    n = int(os.environ.get("BENCH_IO_N", 512))
+    batch = int(os.environ.get("BENCH_IO_BATCH", 64))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench.rec")
+        synth_rec(path, n=min(n, 256))
+        native = run(path, n=min(n, 256) * 2, batch_size=batch)
+        python = run(path, n=min(n, 128), batch_size=batch,
+                     force_python=True)
+    from mxnet_tpu import _native
+
+    print(json.dumps({
+        "metric": "image_decode_augment_images_per_sec",
+        "native_images_per_sec": round(native, 1),
+        "python_images_per_sec": round(python, 1),
+        "speedup": round(native / python, 2),
+        "native_jpeg": _native.has_jpeg(),
+        "threads": int(os.environ.get("BENCH_IO_THREADS",
+                                      os.cpu_count() or 4)),
+    }))
+
+
+if __name__ == "__main__":
+    main()
