@@ -370,6 +370,77 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
 
 # -- normalization -------------------------------------------------------------
 
+def _bn_train_core(data, g, beta, eps, axis):
+    """Training-mode BN with a hand-written minimal-HBM-pass VJP.
+
+    The naive jnp.mean + jnp.var + autodiff formulation costs ~6 full
+    passes over the activation per layer (measured: 45 ms/step of
+    reduce fusions on ResNet-50 b256 — the single largest line in the
+    step profile).  This version is bandwidth-optimal:
+      fwd: 1 fused read (sum & sumsq together, f32 accumulation) +
+           1 read/write (normalize, fused with whatever follows)
+      bwd: 1 fused read of (x, dy) for the two sums +
+           1 read of (x, dy) / write of dx
+    Stats math is f32 regardless of activation dtype (reference keeps
+    BN stats fp32, src/operator/nn/batch_norm.cc).
+    """
+    axes = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    n = 1.0
+    for i in axes:
+        n *= data.shape[i]
+
+    @jax.custom_vjp
+    def bn(x, gg, bb):
+        out, mean, var, _inv = _fwd_math(x, gg, bb)
+        return out, mean, var
+
+    def _fwd_math(x, gg, bb):
+        xf = x.astype(jnp.float32)
+        s1 = jnp.sum(xf, axis=axes)
+        s2 = jnp.sum(xf * xf, axis=axes)
+        mean = s1 / n
+        var = jnp.maximum(s2 / n - mean * mean, 0.0)
+        inv = lax.rsqrt(var + eps)
+        scale = (gg.astype(jnp.float32) * inv).reshape(shape)
+        shift = (bb.astype(jnp.float32)
+                 - gg.astype(jnp.float32) * inv * mean).reshape(shape)
+        out = (xf * scale + shift).astype(x.dtype)
+        return out, mean, var, inv
+
+    def bn_fwd(x, gg, bb):
+        out, mean, var, inv = _fwd_math(x, gg, bb)
+        return (out, mean, var), (x, gg, mean, inv)
+
+    def bn_bwd(res, cts):
+        x, gg, mean, inv = res
+        dy, dmean, dvar = cts
+        xf = x.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        mean_b = mean.reshape(shape)
+        inv_b = inv.reshape(shape)
+        xhat = (xf - mean_b) * inv_b
+        sum_dy = jnp.sum(dyf, axis=axes)
+        sum_dy_xhat = jnp.sum(dyf * xhat, axis=axes)
+        gf = gg.astype(jnp.float32)
+        coef = (gf * inv).reshape(shape)
+        dx = coef * (dyf - (sum_dy / n).reshape(shape)
+                     - xhat * (sum_dy_xhat / n).reshape(shape))
+        # cotangents on the mean/var outputs themselves (a loss reading
+        # the batch statistics): d mean/dx = 1/n, d var/dx = 2(x-mean)/n
+        if dmean is not None:
+            dx = dx + (dmean.astype(jnp.float32) / n).reshape(shape)
+        if dvar is not None:
+            dx = dx + (dvar.astype(jnp.float32) / n).reshape(shape) \
+                * 2.0 * (xf - mean_b)
+        return (dx.astype(x.dtype), sum_dy_xhat.astype(gg.dtype),
+                sum_dy.astype(gg.dtype))
+
+    bn.defvjp(bn_fwd, bn_bwd)
+    return bn(data, g, beta)
+
+
 @register("BatchNorm", aliases=("batch_norm",), mode_dependent=True)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
@@ -379,18 +450,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     and returns (out, batch_mean, batch_var) when output_mean_var — the gluon
     layer owns the moving-average update (the reference mutates aux states
     in-kernel, src/operator/nn/batch_norm.cc)."""
-    axes = tuple(i for i in range(data.ndim) if i != axis)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _is_training and not use_global_stats:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
-    else:
-        mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps).reshape(shape)
-    out = (data - mean.reshape(shape)) * inv * g.reshape(shape) \
-        + beta.reshape(shape)
+        out, mean, var = _bn_train_core(data, g, beta, eps, axis)
+        if output_mean_var:
+            # stats in the aux dtype so the moving-average update doesn't
+            # drift the running buffers' dtype across steps
+            return (out, mean.astype(moving_mean.dtype),
+                    var.astype(moving_var.dtype))
+        return out
+    mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).reshape(shape)
+    meanf = mean.astype(jnp.float32).reshape(shape)
+    out = ((data.astype(jnp.float32) - meanf) * inv
+           * g.astype(jnp.float32).reshape(shape)
+           + beta.astype(jnp.float32).reshape(shape)).astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

@@ -45,7 +45,12 @@ def _use_interpret():
 
 def _block_sizes(T):
     if T % _LANE == 0:
-        bq = 512 if T % 512 == 0 else (256 if T % 256 == 0 else _LANE)
+        # bq capped at 256: the dq backward's f32 working set at bq=512
+        # (dq scratch + (bq,bk) intermediates + double-buffered operand
+        # blocks) blows the ~16MB scoped-VMEM budget at BERT shapes
+        # (measured: b32·h12·T512·D64 fails to compile at 512, fits at
+        # 256)
+        bq = 256 if T % 256 == 0 else _LANE
         return min(bq, T), _LANE
     # interpret-mode small/odd shapes; real TPU dispatches dense instead
     return T, T
