@@ -97,13 +97,18 @@ class TapeNode:
     follow the post-mutation producer and mis-route cotangents.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "n_outputs", "name")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "n_outputs", "name",
+                 "pure_fn")
 
-    def __init__(self, vjp_fn, inputs, outputs, name=""):
+    def __init__(self, vjp_fn, inputs, outputs, name="", pure_fn=None):
         self.vjp_fn = vjp_fn
         self.outputs = outputs    # list[NDArray]
         self.n_outputs = len(outputs)
         self.name = name
+        # pure (raw-array) re-execution of this op over its diff inputs;
+        # lets create_graph=True replay the subgraph functionally so the
+        # returned grads are themselves differentiable (higher order)
+        self.pure_fn = pure_fn
         links = []
         for arr in inputs:        # diff positions only
             parent = arr._tape_node
@@ -268,6 +273,114 @@ def _apply_grad(arr, ct):
     grad._version += 1
 
 
+def _grad_create_graph(heads, variables, head_grads):
+    """create_graph=True: replay the recorded subgraph as a pure jax
+    function of `variables`, take its vjp, and put the resulting grads
+    BACK on the tape (node whose pure_fn is the grad function itself), so
+    grad-of-grad — to any order — composes.
+
+    TPU-first take on the reference's Imperative::Backward(create_graph)
+    (src/imperative/imperative.cc): instead of recording the backward's
+    kernel-by-kernel execution on the tape, rebuild the functional
+    expression and let jax.vjp transpose it; XLA compiles the whole
+    higher-order program when the caller is under jit/hybridize.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray, _from_jax
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, list):
+            head_grads = [head_grads]
+    if head_grads is None:
+        seeds = [jnp.ones_like(h._data) for h in heads]
+    else:
+        seeds = [jnp.ones_like(h._data) if g is None else g._data
+                 for h, g in zip(heads, head_grads)]
+
+    var_list = list(variables)
+    var_ids = {id(v) for v in var_list}
+
+    head_nodes = []
+    for h in heads:
+        if h._tape_node is None and id(h) not in var_ids:
+            raise MXNetError(
+                "cannot differentiate a head that is not on the tape; "
+                "call grad inside autograd.record()")
+        if h._tape_node is not None:
+            head_nodes.append(h._tape_node)
+
+    # forward-topo order of nodes reachable from heads, stopping at the
+    # variables (they are the leaves of the replayed expression)
+    order, seen = [], set()
+    stack = [(n, False) for n in reversed(head_nodes)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for arr, parent, _slot in node.inputs:
+            if parent is not None and id(arr) not in var_ids \
+                    and id(parent) not in seen:
+                stack.append((parent, False))
+    for node in order:
+        if node.pure_fn is None:
+            raise MXNetError(
+                f"create_graph=True cannot differentiate through "
+                f"'{node.name}': its backward is opaque to higher-order "
+                f"gradients (custom autograd.Function)")
+
+    head_list = list(heads)
+
+    def _replay(vs):
+        val = {id(v): x for v, x in zip(var_list, vs)}
+        for node in order:
+            ins = [val.get(id(arr), arr._data)
+                   for arr, _p, _s in node.inputs]
+            out = node.pure_fn(*ins)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oh, ov in zip(node.outputs, outs):
+                if id(oh) in var_ids:
+                    continue  # a variable is an independent leaf here
+                val[id(oh)] = ov
+        return tuple(val[id(h)] if id(h) in val else h._data
+                     for h in head_list)
+
+    def _gradfn(*vs):
+        _outs, vjp = jax.vjp(_replay, list(vs))
+        (gvs,) = vjp(tuple(seeds))
+        return tuple(
+            g if g is not None and not (
+                hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+            else jnp.zeros_like(v)
+            for g, v in zip(gvs, vs))
+
+    vs0 = [v._data for v in var_list]
+    grads_raw, vjp2 = jax.vjp(_gradfn, *vs0)
+    out_nds = [_from_jax(g) for g in grads_raw]
+
+    def node_vjp(out_ct):
+        # backward() hands a bare leaf for single-output nodes; _gradfn
+        # always returns a tuple
+        cts = (out_ct,) if len(var_list) == 1 else tuple(out_ct)
+        return vjp2(cts)
+
+    node = TapeNode(node_vjp, var_list, out_nds, name="higher_order_grad",
+                    pure_fn=_gradfn)
+    for o in out_nds:
+        o._tape_node = node
+    return out_nds[0] if single else out_nds
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Reference: mx.autograd.grad — return grads w.r.t. `variables` without
@@ -275,9 +388,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     from .ndarray import NDArray, _from_jax
 
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order imperative grad) is not "
-            "supported; use hybridize + functional jax.grad composition")
+        return _grad_create_graph(heads, variables, head_grads)
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]

@@ -364,14 +364,17 @@ class HybridBlock(Block):
                 "with this block at least once before calling export.")
         sym = _sym.trace_block(self)
         sym.save(f"{path}-symbol.json")
-        params = self._collect_params_with_prefix()
         from ..ndarray import save as nd_save
 
+        # arg:/aux: keyed by the SAME global names the traced Variables
+        # carry (Parameter.name), the reference's deploy convention —
+        # SymbolBlock.imports matches sym.list_inputs() against these
         arg_dict = {}
-        for name, param in params.items():
-            arg_dict[f"arg:{self.prefix}{name.replace('.', '_')}"] = \
-                param.data()
+        for name, param in self.collect_params().items():
+            tag = "aux" if param.grad_req == "null" else "arg"
+            arg_dict[f"{tag}:{name}"] = param.data()
         nd_save(f"{path}-{epoch:04d}.params", arg_dict)
+        return sym
 
     # -- forward dispatch ------------------------------------------------------
 
@@ -380,6 +383,22 @@ class HybridBlock(Block):
             if self._active and not _TRACE.force_eager:
                 return self._call_cached_op(x, *args)
             return self._eager_forward(x, *args)
+        from ..symbol import Symbol as _Symbol
+
+        if isinstance(x, _Symbol):
+            # symbolic dual dispatch (reference: F=mx.sym in
+            # hybrid_forward): parameters become named Variables so the
+            # traced graph round-trips through symbol.json + .params
+            from .. import symbol as _sym_mod
+
+            params = {}
+            for k, p in self._reg_params.items():
+                v = p.var()
+                if p.grad_req == "null":
+                    v._set_attr(__aux__=True)
+                    v.attrs["__aux__"] = True
+                params[k] = v
+            return self.hybrid_forward(_sym_mod, x, *args, **params)
         # raw array / tracer: pure path inside an enclosing trace
         params = {}
         for k, p in self._reg_params.items():
@@ -532,8 +551,20 @@ class HybridBlock(Block):
             pv_ct, dyn_ct = vjp_fn(full_ct)
             return list(pv_ct) + [dyn_ct[j] for j in nd_pos_in_dyn]
 
+        n_params = len(param_vals)
+
+        def tape_pure(*raw):
+            pv = list(raw[:n_params])
+            dr = list(dyn_raw)
+            for j, v in zip(nd_pos_in_dyn, raw[n_params:]):
+                dr[j] = v
+            out_p, _aux = jfn(key, pv, dr)
+            leaves, _ = jtu.tree_flatten(out_p)
+            return tuple(leaves) if len(leaves) > 1 else leaves[0]
+
         node = _ag.TapeNode(tape_vjp, param_nds + nd_inputs, outs,
-                            name=f"CachedOp:{self.name}")
+                            name=f"CachedOp:{self.name}",
+                            pure_fn=tape_pure)
         for o in outs:
             o._tape_node = node
         return jtu.tree_unflatten(out_tree, outs)
@@ -596,11 +627,18 @@ class SymbolBlock(HybridBlock):
         self._outputs_sym = outputs
         self._input_names = [i.name for i in inputs]
         input_set = set(self._input_names)
-        # every non-input free variable becomes a parameter of this block
+        # every non-input free variable becomes a parameter of this block,
+        # under its EXACT traced name (no symbolblock prefix — the deploy
+        # .params file is keyed by the original global names)
+        from .parameter import Parameter as _Param
+
+        aux = set(outputs.list_auxiliary_states())
         for name in outputs.list_inputs():
-            if name not in input_set:
-                self.params.get(name, shape=None, dtype=None,
-                                allow_deferred_init=True, grad_req="null")
+            if name not in input_set and name not in self.params._params:
+                self.params._params[name] = _Param(
+                    name, shape=None, dtype=None,
+                    allow_deferred_init=True,
+                    grad_req="null" if name in aux else "write")
 
     def forward(self, *args):
         from .. import symbol as _sym

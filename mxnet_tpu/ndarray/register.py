@@ -119,7 +119,8 @@ def _invoke_inner(opdef: OpDef, fn, args: tuple, kwargs: dict):
         single = not isinstance(out, (tuple, list))
         outs_j = [out] if single else list(out)
         outs = [_wrap(o, ctx) for o in outs_j]
-        node = _ag.TapeNode(vjp_fn, nd_list, outs, name=opdef.name)
+        node = _ag.TapeNode(vjp_fn, nd_list, outs, name=opdef.name,
+                            pure_fn=pure_fn)
         for o in outs:
             if isinstance(o, NDArray):
                 o._tape_node = node

@@ -125,14 +125,18 @@ class Symbol:
         """Free variables in topo order, aux excluded (reference:
         Symbol.list_arguments)."""
         return [n.name for n in self._topo()
-                if n.op is None and not n._attr_dict.get("__aux__")]
+                if n.op is None and not n._attr_dict.get("__aux__")
+                and "__scalar__" not in n.attrs
+                and "__null__" not in n.attrs]
 
     def list_auxiliary_states(self):
         return [n.name for n in self._topo()
                 if n.op is None and n._attr_dict.get("__aux__")]
 
     def list_inputs(self):
-        return [n.name for n in self._topo() if n.op is None]
+        return [n.name for n in self._topo()
+                if n.op is None and "__scalar__" not in n.attrs
+                and "__null__" not in n.attrs]
 
     def list_outputs(self):
         if self._n_outputs == 1:
@@ -158,6 +162,8 @@ class Symbol:
         if node.op is None:
             if "__scalar__" in node.attrs:
                 val = node.attrs["__scalar__"]
+            elif node.attrs.get("__null__"):
+                val = None  # absent optional tensor slot (e.g. bias)
             elif node.name in env:
                 val = env[node.name]
             else:
@@ -170,7 +176,19 @@ class Symbol:
                     v = v[i.out_index]
                 args.append(v)
             opdef = _registry.get(node.op)
-            val = opdef.fn(*args, **node.attrs)
+            kwargs = dict(node.attrs)
+            kwargs.pop("__aux__", None)
+            # same execution-scope injection the ndarray invoke wrapper
+            # does: mode from the autograd scope, PRNG from the key scope
+            if opdef.mode_dependent and kwargs.get("_is_training") is None:
+                from .. import autograd as _ag
+
+                kwargs["_is_training"] = _ag.is_training()
+            if opdef.random and kwargs.get("_key") is None:
+                from ..random import next_key
+
+                kwargs["_key"] = next_key()
+            val = opdef.fn(*args, **kwargs)
         cache[key] = val
         return val
 
@@ -456,9 +474,17 @@ Variable = var
 
 
 def _scalar_sym(value):
+    value = value if isinstance(value, (int, bool)) else float(value)
     s = var(_auto_name("scalar"))
-    s._set_attr(__scalar__=float(value))
-    s.attrs["__scalar__"] = float(value)
+    s._set_attr(__scalar__=value)
+    s.attrs["__scalar__"] = value
+    return s
+
+
+def _null_sym():
+    s = var(_auto_name("null"))
+    s._set_attr(__null__=True)
+    s.attrs["__null__"] = True
     return s
 
 
@@ -467,6 +493,18 @@ def apply_op(opname, *sym_inputs, name=None, **kwargs):
     _registry.get(opname)  # validate now
     nm = name or _auto_name(opname.lower().replace("_", ""))
     inputs = list(sym_inputs)
+    # absent optional tensor args (e.g. bias with use_bias=False) arrive
+    # as trailing Nones from layer code — drop them; the op fn's own
+    # defaults apply at eval.  Interior Nones would misalign positions.
+    while inputs and inputs[-1] is None:
+        inputs.pop()
+    # interior Nones (an absent bias BETWEEN tensor args) become null
+    # placeholder variables that evaluate to None, keeping positions
+    inputs = [_null_sym() if i is None else i for i in inputs]
+    # positional python scalars (e.g. clip(x, 0, 6) in relu6) become
+    # scalar-constant variables so positions stay aligned at eval
+    inputs = [i if isinstance(i, Symbol) else _scalar_sym(i)
+              for i in inputs]
     # multi-output ops: reflected lazily when indexing
     return Symbol(opname, nm, inputs, kwargs)
 
@@ -491,7 +529,13 @@ def fromjson(data):
             except (json.JSONDecodeError, TypeError):
                 attrs[k] = v
         if nd["op"] == "null":
-            built.append(var(nd["name"]))
+            v = var(nd["name"])
+            # restore variable-level attrs (__scalar__ values, __aux__
+            # markers) so save/load round-trips evaluation semantics
+            v.attrs.update(attrs)
+            if attrs.get("__aux__"):
+                v._set_attr(__aux__=True)
+            built.append(v)
         else:
             inputs = [built[i][oi] for i, oi, _ in nd["inputs"]]
             sym = apply_op(nd["op"], *inputs, name=nd["name"], **attrs)
@@ -500,10 +544,31 @@ def fromjson(data):
     return built[head][oi] if oi else built[head]
 
 
-def trace_block(block):
-    """Build a Symbol graph from a hybridized gluon block by symbolic
-    tracing (the HybridBlock.export path)."""
-    raise NotImplementedError(
-        "symbolic export of arbitrary hybrid blocks lands with the jaxpr→"
-        "Symbol converter; use Block.save_parameters + SymbolBlock for "
-        "python-defined models, or build graphs with mx.sym directly")
+def trace_block(block, inputs=None):
+    """Build a Symbol graph from a hybridized gluon block by running its
+    hybrid_forward with ``F = mx.sym`` and Variable inputs — the
+    reference's dual-dispatch export path (python/mxnet/gluon/block.py
+    HybridBlock._build_cache builds the nnvm graph the same way).
+
+    Tracing happens in predict mode: the deploy format is an inference
+    graph (BatchNorm normalizes with global stats, Dropout is identity),
+    matching the reference's exported symbol.json semantics.
+    """
+    from .. import autograd as _ag
+
+    if inputs is None:
+        inputs = [var("data")]
+    elif isinstance(inputs, str):
+        inputs = [var(inputs)]
+    elif all(isinstance(i, str) for i in inputs):
+        inputs = [var(i) for i in inputs]
+    with _ag.predict_mode(), _ag.pause():
+        out = block(*inputs)
+    if isinstance(out, (list, tuple)):
+        return Group(list(out))
+    if not isinstance(out, Symbol):
+        raise MXNetError(
+            f"trace_block: block {block} returned {type(out).__name__}, "
+            "not a Symbol — its forward() bypasses hybrid_forward (pure "
+            "imperative Block); export requires a HybridBlock")
+    return out

@@ -180,3 +180,79 @@ def test_softmax_output_custom_grad():
     onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
     # reference default normalization='null': grad is p - onehot, unscaled
     assert np.allclose(x.grad.asnumpy(), p - onehot, rtol=1e-4, atol=1e-5)
+
+
+# -- higher-order (create_graph=True) ------------------------------------------
+# Reference: tests/python/unittest/test_higher_order_grad.py
+
+def test_second_order_cube():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dy = autograd.grad(y, x, create_graph=True)
+    dy.backward()
+    # d2(x^3)/dx2 = 6x
+    assert np.allclose(x.grad.asnumpy(), 6 * x.asnumpy())
+
+
+def test_second_order_sin():
+    x = nd.array([0.3, -0.7, 1.2])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        dy = autograd.grad(y, x, create_graph=True)
+        z = (dy * dy).sum()
+    z.backward()
+    # d/dx (cos^2 x) = -2 cos x sin x
+    expect = -2 * np.cos(x.asnumpy()) * np.sin(x.asnumpy())
+    assert np.allclose(x.grad.asnumpy(), expect, atol=1e-5)
+
+
+def test_third_order():
+    x = nd.array([0.5, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x          # x^4
+        d1 = autograd.grad(y, x, create_graph=True)   # 4x^3
+        d2 = autograd.grad(d1, x, create_graph=True)  # 12x^2
+    d2.backward()                                     # 24x
+    assert np.allclose(x.grad.asnumpy(), 24 * x.asnumpy(), atol=1e-4)
+
+
+def test_create_graph_multivar():
+    x = nd.array([1.0, 2.0])
+    w = nd.array([3.0, 4.0])
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = (x * x * w).sum()
+        dx, dw = autograd.grad(y, [x, w], create_graph=True)
+        z = (dx * dx).sum() + (dw * dw).sum()
+    z.backward()
+    # dx = 2xw, dw = x^2; z = sum 4x^2w^2 + x^4
+    # dz/dx = 8xw^2 + 4x^3 ; dz/dw = 8x^2 w
+    xn, wn = x.asnumpy(), w.asnumpy()
+    assert np.allclose(x.grad.asnumpy(), 8 * xn * wn ** 2 + 4 * xn ** 3)
+    assert np.allclose(w.grad.asnumpy(), 8 * xn ** 2 * wn)
+
+
+def test_second_order_through_hybridized_block():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(1, use_bias=False, in_units=2)
+    net.initialize()
+    net.hybridize()
+    x = nd.array([[0.5, -1.0]])
+    x.attach_grad()
+    net(x)  # build/compile
+    with autograd.record():
+        y = net(x)
+        dx = autograd.grad(y, x, create_graph=True)
+        z = (dx * dx).sum()
+    z.backward()
+    # y = xW^T, dx = W (const in x), z = |W|^2 -> d z/dx = 0
+    assert np.allclose(x.grad.asnumpy(), 0.0)
+    # and dx itself equals the weight row
+    w = net.weight.data().asnumpy()
+    assert np.allclose(dx.asnumpy(), w.reshape(1, -1), atol=1e-6)

@@ -132,3 +132,97 @@ def test_symbol_grouping():
     g = mx.sym.Group([s1, s2])
     outs = g.eval_raw(a=np.array([-1.0, 1.0], np.float32))
     assert len(outs) == 2
+
+
+# -- HybridBlock.export / SymbolBlock.imports (deploy format) ------------------
+# Reference: tests/python/unittest/test_gluon.py::test_export/test_import
+
+def test_export_import_roundtrip_mlp(tmp_path):
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dropout(0.5),
+            gluon.nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(3, 8).astype("float32"))
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x)
+    sym = net.export(str(tmp_path / "model"))
+    # traced graph exposes params/aux under their global names (the
+    # numeric suffix depends on gluon's process-wide name counter)
+    assert any(a.endswith("_running_mean")
+               for a in sym.list_auxiliary_states())
+    assert any("dense" in a and a.endswith("_weight")
+               for a in sym.list_arguments())
+    sb = gluon.SymbolBlock.imports(
+        str(tmp_path / "model-symbol.json"), ["data"],
+        str(tmp_path / "model-0000.params"))
+    out = sb(x)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_export_import_roundtrip_convnet(tmp_path):
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, use_bias=False),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(1).randn(2, 3, 16, 16)
+                 .astype("float32"))
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x)
+    net.export(str(tmp_path / "conv"))
+    sb = gluon.SymbolBlock.imports(
+        str(tmp_path / "conv-symbol.json"), ["data"],
+        str(tmp_path / "conv-0000.params"))
+    np.testing.assert_allclose(sb(x).asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_export_import_resnet18(tmp_path):
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(2).randn(2, 3, 32, 32)
+                 .astype("float32"))
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x)
+    net.export(str(tmp_path / "r18"))
+    sb = gluon.SymbolBlock.imports(
+        str(tmp_path / "r18-symbol.json"), ["data"],
+        str(tmp_path / "r18-0000.params"))
+    np.testing.assert_allclose(sb(x).asnumpy(), ref.asnumpy(), atol=1e-5)
+
+
+def test_exported_json_scalar_positional_roundtrip(tmp_path):
+    # relu6 (clip(x, 0, 6)) traces scalar positionals; they must survive
+    # save/load as constants, not become loadable parameters
+    from mxnet_tpu import gluon, nd
+
+    class Relu6(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.clip(x, 0.0, 6.0)
+
+    net = Relu6()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.array([[-1.0, 3.0, 9.0]], np.float32))
+    net(x)
+    net.export(str(tmp_path / "r6"))
+    sb = gluon.SymbolBlock.imports(str(tmp_path / "r6-symbol.json"),
+                                   ["data"])
+    np.testing.assert_allclose(sb(x).asnumpy(), [[0.0, 3.0, 6.0]])
