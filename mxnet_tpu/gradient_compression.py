@@ -1,0 +1,107 @@
+"""Gradient compression for KVStore synchronization.
+
+Reference parity: src/kvstore/gradient_compression.cc /
+gradient_compression-inl.h — threshold-based 2-bit quantization with
+per-key error feedback (residual accumulation), enabled via
+``kvstore.set_gradient_compression({'type': '2bit', 'threshold': t})``.
+
+TPU-first redesign: the reference quantizes worker→server pushes to cut
+PS/TCP bandwidth; here the expensive hop is DCN between hosts, and the
+collective is GSPMD.  ``2bit`` packs four 2-bit codes per uint8 and the
+cross-process exchange becomes an all-gather of the PACKED codes (W ×
+n/4 bytes on the wire instead of W-1 rounds of dense bf16/f32 ring
+all-reduce), decoded and summed on-device inside one jitted program.
+An ``fp16`` mode (half-precision transfer with error feedback) is also
+provided.  Quantization semantics match the reference exactly:
+
+    q_i =  +threshold   if (g+r)_i >= threshold
+           -threshold   if (g+r)_i <= -threshold
+           0            otherwise
+    r   <- (g+r) - q          (error feedback)
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+_SHIFTS = (0, 2, 4, 6)
+
+
+class GradientCompression:
+    """Per-KVStore compression state: type, threshold, per-key residuals."""
+
+    def __init__(self, params):
+        params = dict(params or {})
+        self.type = params.pop("type", "2bit")
+        self.threshold = float(params.pop("threshold", 0.5))
+        if params:
+            raise MXNetError(
+                f"unknown gradient compression params {sorted(params)}")
+        if self.type not in ("2bit", "fp16"):
+            raise MXNetError(
+                f"gradient compression type '{self.type}' is not "
+                "supported (2bit, fp16)")
+        if self.type == "2bit" and self.threshold <= 0:
+            raise MXNetError("2bit compression needs a positive threshold")
+        self._residual = {}  # key -> raw residual array
+
+    # -- quantization (local, with error feedback) -----------------------------
+
+    def quantize(self, key, grad):
+        """Return the dequantized-on-this-worker gradient contribution and
+        update the residual.  ``grad`` is a raw jax array."""
+        import jax.numpy as jnp
+
+        r = self._residual.get(key)
+        acc = grad if r is None else grad + r
+        if self.type == "fp16":
+            q = acc.astype(jnp.float16).astype(grad.dtype)
+        else:
+            t = jnp.asarray(self.threshold, grad.dtype)
+            q = jnp.where(acc >= t, t,
+                          jnp.where(acc <= -t, -t,
+                                    jnp.zeros((), grad.dtype)))
+        self._residual[key] = acc - q
+        return q
+
+    def codes(self, key, grad):
+        """2bit only: quantize with error feedback and return PACKED uint8
+        codes (4 values/byte) for the wire."""
+        import jax.numpy as jnp
+
+        assert self.type == "2bit"
+        r = self._residual.get(key)
+        acc = grad if r is None else grad + r
+        t = jnp.asarray(self.threshold, grad.dtype)
+        pos = acc >= t
+        neg = acc <= -t
+        q = jnp.where(pos, t, jnp.where(neg, -t,
+                                        jnp.zeros((), grad.dtype)))
+        self._residual[key] = acc - q
+        c = jnp.where(pos, jnp.uint8(1),
+                      jnp.where(neg, jnp.uint8(2), jnp.uint8(0)))
+        flat = c.reshape(-1)
+        pad = (-flat.shape[0]) % 4
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
+        quads = flat.reshape(-1, 4)
+        packed = (quads[:, 0] | (quads[:, 1] << 2)
+                  | (quads[:, 2] << 4) | (quads[:, 3] << 6))
+        return packed.astype(jnp.uint8)
+
+    @staticmethod
+    def decode_sum(packed_rows, n, threshold, dtype):
+        """Decode a (W, n/4) stack of packed code rows and sum the
+        dequantized contributions → dense (n,).  jit-traceable."""
+        import jax.numpy as jnp
+
+        shifts = jnp.asarray(_SHIFTS, jnp.uint8)
+        bits = (packed_rows[:, :, None] >> shifts[None, None, :]) & 3
+        codes = bits.reshape(packed_rows.shape[0], -1)[:, :n]
+        t = jnp.asarray(threshold, dtype)
+        vals = jnp.where(codes == 1, t,
+                         jnp.where(codes == 2, -t, jnp.zeros((), dtype)))
+        return vals.sum(axis=0, dtype=dtype)
+
+    def reset(self):
+        self._residual.clear()

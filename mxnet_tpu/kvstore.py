@@ -30,6 +30,15 @@ def _as_list(x):
 _ALLREDUCE_CACHE = {}
 
 
+def _device_fingerprint():
+    """Cache key component: the current global device set.  Invalidates
+    compiled all-reduce programs if the set changes across a
+    preemption/restart (the §5.3 recovery story)."""
+    import jax
+
+    return tuple(sorted((d.process_index, d.id) for d in jax.devices()))
+
+
 def _cross_process_allreduce(raw):
     """Eager cross-process all-reduce: each process contributes its local
     value; the summed result comes back replicated.
@@ -47,7 +56,7 @@ def _cross_process_allreduce(raw):
     from jax.experimental import multihost_utils
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    key = (tuple(raw.shape), str(raw.dtype))
+    key = (tuple(raw.shape), str(raw.dtype), _device_fingerprint())
     entry = _ALLREDUCE_CACHE.get(key)
     if entry is None:
         # one device per process: the DCN axis
@@ -70,6 +79,46 @@ def _cross_process_allreduce(raw):
         out, mesh, PartitionSpec())
 
 
+def _cross_process_compressed_allreduce(packed, n, threshold, dtype):
+    """2-bit wire format: all-gather each worker's PACKED codes (uint8,
+    4 grads/byte — the bytes that cross DCN), decode and sum on-device.
+    Reference: GradientCompression::Quantize/Dequantize around the
+    ps-lite push (src/kvstore/gradient_compression.cc)."""
+    import numpy as _np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from .gradient_compression import GradientCompression
+
+    key = ("2bit", int(packed.size), int(n), float(threshold), str(dtype),
+           _device_fingerprint())
+    entry = _ALLREDUCE_CACHE.get(key)
+    if entry is None:
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[p] for p in sorted(per_proc)]
+        mesh = Mesh(_np.asarray(devs), ("w",))
+        in_s = NamedSharding(mesh, PartitionSpec("w"))
+        out_s = NamedSharding(mesh, PartitionSpec())
+
+        fn = jax.jit(
+            lambda x: GradientCompression.decode_sum(x, n, threshold,
+                                                     dtype),
+            in_shardings=in_s, out_shardings=out_s)
+        entry = (mesh, fn)
+        _ALLREDUCE_CACHE[key] = entry
+    mesh, fn = entry
+    garr = multihost_utils.host_local_array_to_global_array(
+        jnp.asarray(packed)[None], mesh, PartitionSpec("w"))
+    out = fn(garr)
+    return multihost_utils.global_array_to_host_local_array(
+        out, mesh, PartitionSpec())
+
+
 class KVStore:
     """In-process KVStore over XLA reductions (reference:
     include/mxnet/kvstore.h)."""
@@ -79,6 +128,7 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
         self._is_dist = kv_type.startswith("dist")
         if self._is_dist:
             from . import distributed
@@ -120,26 +170,42 @@ class KVStore:
             return list(key), list(value)
         return [key], [value]
 
-    def _reduce(self, values):
+    def _reduce(self, key, values):
         """Sum a device-value list (reference: Comm tree/NCCL reduce) and,
-        for dist types, all-reduce across processes over ICI/DCN."""
+        for dist types, all-reduce across processes over ICI/DCN.  With
+        gradient compression active, each worker's contribution is
+        quantized (with error feedback) before the exchange, and the
+        2-bit wire format is an all-gather of packed codes."""
         vals = _as_list(values)
         merged = vals[0]
         for v in vals[1:]:
             merged = merged + v
-        if self._is_dist and self.num_workers > 1:
-            raw = merged._data if isinstance(merged, NDArray) else merged
-            summed = _cross_process_allreduce(raw)
-            merged = _from_jax(summed) if isinstance(merged, NDArray) \
-                else summed
-        return merged
+        gc = self._compression
+        multi = self._is_dist and self.num_workers > 1
+        if gc is None:
+            if multi:
+                raw = merged._data if isinstance(merged, NDArray) else merged
+                summed = _cross_process_allreduce(raw)
+                merged = _from_jax(summed) if isinstance(merged, NDArray) \
+                    else summed
+            return merged
+        raw = merged._data if isinstance(merged, NDArray) else merged
+        if multi and gc.type == "2bit":
+            packed = gc.codes(key, raw)
+            summed = _cross_process_compressed_allreduce(
+                packed, raw.size, gc.threshold, raw.dtype)
+            summed = summed.reshape(raw.shape)
+        else:
+            q = gc.quantize(key, raw)
+            summed = _cross_process_allreduce(q) if multi else q
+        return _from_jax(summed) if isinstance(merged, NDArray) else summed
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
-            merged = self._reduce(v)
+            merged = self._reduce(k, v)
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(k, merged, stored)
@@ -220,9 +286,17 @@ class KVStore:
 
     # -- misc parity -----------------------------------------------------------
     def set_gradient_compression(self, compression_params):
-        """Reference: 2-bit gradient compression (gradient_compression.cc).
-        Collectives over ICI are not bandwidth-bound the way PS/TCP was; kept
-        as a no-op knob for API parity."""
+        """Enable gradient compression (reference:
+        src/kvstore/gradient_compression.cc): '2bit' threshold
+        quantization with per-key error feedback, or 'fp16' transfer.
+        The reference restricts this to device/dist stores; same here."""
+        from .gradient_compression import GradientCompression
+
+        if not (self._is_dist or self._type == "device"):
+            raise MXNetError(
+                "gradient compression is supported for 'device' and "
+                "'dist_*' kvstore types (reference semantics)")
+        self._compression = GradientCompression(compression_params)
         self._compression_params = compression_params
 
     def barrier(self):

@@ -75,6 +75,30 @@ def main():
     kv2.pull("wcheck", out=avg)
     np.testing.assert_allclose(avg.asnumpy() / nw, w, rtol=1e-5,
                                atol=1e-6)
+
+    # -- 2-bit gradient compression over the real wire -------------------------
+    # (reference: tests/nightly/dist_sync_kvstore.py compressed section)
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv3.init("c0", mx.nd.zeros((5,)))
+    kv3.set_updater(lambda k, g, s: s._set_data((s + g)._data))
+    # worker r pushes [-0.8, 0.6, 0.2, 0.9, -0.1]; every worker
+    # quantizes identically -> sum = nw * [-0.5, 0.5, 0, 0.5, 0]
+    kv3.push("c0", mx.nd.array(np.array([-0.8, 0.6, 0.2, 0.9, -0.1],
+                                        np.float32)))
+    oc = mx.nd.zeros((5,))
+    kv3.pull("c0", out=oc)
+    np.testing.assert_allclose(
+        oc.asnumpy(), nw * np.array([-0.5, 0.5, 0.0, 0.5, 0.0]),
+        atol=1e-6)
+    # error feedback: second identical push sees acc = g + r
+    kv3.push("c0", mx.nd.array(np.array([-0.8, 0.6, 0.2, 0.9, -0.1],
+                                        np.float32)))
+    kv3.pull("c0", out=oc)
+    # acc=[-1.1,0.7,0.4,1.3,-0.2] -> q=[-0.5(hit twice: -1.0),...]
+    np.testing.assert_allclose(
+        oc.asnumpy(), nw * np.array([-1.0, 1.0, 0.0, 1.0, 0.0]),
+        atol=1e-6)
     print(f"worker {rank}/{nw}: dist_sync_kvstore OK", flush=True)
 
 

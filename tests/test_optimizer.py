@@ -179,3 +179,75 @@ def test_lr_wd_mult():
     assert o._get_lr(1) == 1.0
     # bias gets wd_mult 0 automatically (reference behavior)
     assert o._get_wd(1) == 0.0
+
+
+# -- gradient compression (reference: src/kvstore/gradient_compression.cc) -----
+
+def test_gradient_compression_2bit_error_feedback():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros(4))
+    kv.set_updater(lambda k, g, s: s._set_data((s + g)._data))
+
+    g = nd.array([0.6, -0.7, 0.2, 0.3])
+    kv.push("w", g)
+    out = nd.zeros(4)
+    kv.pull("w", out=out)
+    # quantized: [0.5, -0.5, 0, 0]; residual [0.1, -0.2, 0.2, 0.3]
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0],
+                               atol=1e-6)
+    # second push: acc = g + r = [0.7, -0.9, 0.4, 0.6]
+    #   -> q [0.5, -0.5, 0, 0.5]; store accumulates
+    kv.push("w", g)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, -1.0, 0.0, 0.5],
+                               atol=1e-6)
+
+
+def test_gradient_compression_fp16():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "fp16"})
+    kv.init("w", nd.zeros(3))
+    kv.set_updater(lambda k, g, s: s._set_data((s + g)._data))
+    vals = np.array([1.0 + 2 ** -12, -3.14159, 1e-8], np.float32)
+    kv.push("w", nd.array(vals))
+    out = nd.zeros(3)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               vals.astype(np.float16).astype(np.float32))
+
+
+def test_gradient_compression_rejects_local():
+    import pytest
+
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"type": "2bit"})
+
+
+def test_gradient_compression_pack_decode_roundtrip():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gradient_compression import GradientCompression
+
+    gc = GradientCompression({"type": "2bit", "threshold": 0.25})
+    rs = np.random.RandomState(0)
+    g = jnp.asarray(rs.randn(103).astype(np.float32))  # non-multiple of 4
+    packed = gc.codes("k", g)
+    assert packed.dtype == jnp.uint8 and packed.size == (103 + 1) // 4 * 1
+    dec = GradientCompression.decode_sum(packed[None], 103, 0.25,
+                                         jnp.float32)
+    expect = np.where(np.asarray(g) >= 0.25, 0.25,
+                      np.where(np.asarray(g) <= -0.25, -0.25, 0.0))
+    np.testing.assert_allclose(np.asarray(dec), expect, atol=1e-7)
+    # residual carries the quantization error
+    r = np.asarray(gc._residual["k"])
+    np.testing.assert_allclose(r, np.asarray(g) - expect, atol=1e-6)
