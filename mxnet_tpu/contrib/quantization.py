@@ -1,11 +1,15 @@
 """Model quantization flow.
 
 Reference parity: python/mxnet/contrib/quantization.py — quantize_model
-(calibration-based int8 conversion, ≥1.2).
+(calibration-based int8 conversion, ≥1.2): symbol-graph rewrite inserting
+quantize_v2 → quantized_conv/quantized_fully_connected → dequantize
+around the MXU-heavy ops, with 'naive' (min/max) and 'entropy'
+(KL-optimal threshold) calibration, plus a gluon front door
+(quantize_net) that composes trace_block → quantize_model → SymbolBlock.
 
-TPU flow: calibrate activation ranges by running batches through the fp
-model (min/max or percentile), then wrap Dense/Conv layers so inference
-runs the int8 MXU path (ops/quantization.py).
+TPU flow: int8×int8→int32 runs on the MXU (ops/quantization.py);
+ranges ride the graph as scalar-constant symbols exactly like the
+reference's (data, min, max) triples.
 """
 
 from __future__ import annotations
@@ -13,6 +17,8 @@ from __future__ import annotations
 import numpy as _np
 
 from ..base import MXNetError
+
+_QUANTIZABLE = {"Convolution", "FullyConnected"}
 
 
 class CalibrationCollector:
@@ -40,6 +46,297 @@ class CalibrationCollector:
         return self.ranges[name]
 
 
+def _smooth_distribution(p, eps=0.0001):
+    """Reference: _smooth_distribution — move eps mass onto zero bins so
+    KL is finite, taken proportionally from nonzero bins."""
+    is_zeros = (p == 0).astype(_np.float64)
+    is_nonzeros = (p != 0).astype(_np.float64)
+    n_zeros = int(is_zeros.sum())
+    n_nonzeros = p.size - n_zeros
+    if not n_nonzeros:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    hist = p.astype(_np.float64)
+    hist += eps * is_zeros + (-eps1) * is_nonzeros
+    if (hist <= 0).any():
+        return None
+    return hist
+
+
+def _get_optimal_threshold(arr, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| for int8 (reference:
+    _get_optimal_threshold in python/mxnet/contrib/quantization.py —
+    the TensorRT-style entropy calibration).
+
+    The load-bearing subtlety (reference keeps it too): the candidate
+    distribution ``p`` has the clipped outlier mass merged into its edge
+    bin while ``q`` is built from the UNMERGED histogram — so a
+    too-small threshold is penalized for the mass it throws away.
+    """
+    a = _np.abs(_np.asarray(arr, dtype=_np.float64).ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax == 0:
+        return 1e-8
+    hist, edges = _np.histogram(a, bins=num_bins, range=(0, amax))
+    hist = hist.astype(_np.float64)
+
+    best_kl, best_t = _np.inf, amax
+    step = max(1, (num_bins - num_quantized_bins) // 128)
+    for i in range(num_quantized_bins, num_bins + 1, step):
+        t = edges[i] if i < len(edges) else amax
+        sliced = hist[:i]
+        if sliced.sum() == 0:
+            continue
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()  # clipped mass -> edge bin (p only)
+        num_merged = i // num_quantized_bins
+        qb = _np.add.reduceat(
+            sliced[:num_quantized_bins * num_merged],
+            _np.arange(0, num_quantized_bins * num_merged, num_merged))
+        qb[-1] += sliced[num_quantized_bins * num_merged:].sum()
+        q = _np.zeros(i)
+        is_nz = sliced != 0
+        for j in range(num_quantized_bins):
+            lo = j * num_merged
+            hi = i if j == num_quantized_bins - 1 else lo + num_merged
+            nz = is_nz[lo:hi]
+            n = int(nz.sum())
+            if n:
+                q[lo:hi][nz] = qb[j] / n
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
+            continue
+        ps = ps / ps.sum()
+        qs = qs / qs.sum()
+        kl = float(_np.sum(ps * _np.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return float(best_t)
+
+
+def _collect_calib_ranges(sym, points, data_names, calib_data,
+                          num_calib_examples, calib_mode, params=None):
+    """Run the float graph on calibration batches, recording ranges at
+    each quantize-insertion point (reference: collect_layer_output)."""
+    from .. import symbol as _sym_mod
+    from ..ndarray.ndarray import NDArray
+
+    group = _sym_mod.Group([p for _, p in points])
+    # naive streams a running (min, max); entropy keeps a bounded random
+    # subsample per point (the KL search needs the value distribution,
+    # but not every activation of every batch in host RAM)
+    minmax = {}
+    samples = {name: [] for name, _ in points}
+    budget = 1 << 16  # per-point per-batch subsample cap
+    rng = _np.random.RandomState(0)
+    seen = 0
+    for batch in calib_data:
+        x = batch.data[0] if hasattr(batch, "data") else batch
+        feed = dict(params or {})
+        feed[data_names[0]] = x
+        env = {k: (v._data if isinstance(v, NDArray) else v)
+               for k, v in feed.items()}
+        outs = group.eval_raw(**env)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        for (name, _), o in zip(points, outs):
+            a = _np.asarray(o).ravel()
+            lo, hi = float(a.min()), float(a.max())
+            plo, phi = minmax.get(name, (lo, hi))
+            minmax[name] = (min(lo, plo), max(hi, phi))
+            if calib_mode == "entropy":
+                if a.size > budget:
+                    a = a[rng.randint(0, a.size, budget)]
+                samples[name].append(a.astype(_np.float32))
+        seen += int(x.shape[0])
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    ranges = {}
+    for name, _ in points:
+        if name not in minmax:
+            continue
+        if calib_mode == "entropy":
+            allv = _np.concatenate(samples[name])
+            t = _get_optimal_threshold(allv)
+            ranges[name] = (-t, t)
+        else:
+            ranges[name] = minmax[name]
+    return ranges
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=(), calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", **kwargs):
+    """Rewrite a float Symbol graph for int8 inference (reference:
+    quantize_model, python/mxnet/contrib/quantization.py).
+
+    Returns (qsym, qarg_params, aux_params): Convolution/FullyConnected
+    nodes become quantize_v2 → quantized_* → dequantize chains, weights
+    are offline-quantized to int8 in qarg_params, and — with
+    calib_mode 'naive'/'entropy' — activation quantizers carry static
+    calibrated ranges so inference needs no runtime min/max pass.
+    """
+    from .. import ndarray as _nd
+    from .. import symbol as _sym_mod
+    from ..ndarray.ndarray import NDArray
+
+    if quantized_dtype != "int8":
+        raise MXNetError("TPU quantization supports int8 (MXU int8 path)")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode}")
+    excluded = set(excluded_sym_names or ())
+    data_names = ([data_names] if isinstance(data_names, str)
+                  else list(data_names))
+
+    topo = sym._topo()
+    # pre-pass: the float input symbol of every quantizable node
+    points = []
+    for node in topo:
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and node.inputs:
+            points.append((node.name, _as_entry(node.inputs[0])))
+
+    calib_ranges = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode='{calib_mode}' needs calib_data")
+        bound = {**(arg_params or {}), **(aux_params or {})}
+        calib_ranges = _collect_calib_ranges(
+            sym, points, data_names, calib_data, num_calib_examples,
+            calib_mode, params=bound)
+
+    qarg_params = dict(arg_params or {})
+    rebuilt = {}  # original node name -> rebuilt Symbol (node-level)
+
+    def lookup(entry):
+        """Rebuilt symbol for one original input entry."""
+        node = entry
+        r = rebuilt[node.name]
+        if node.out_index:
+            return r[node.out_index]
+        return r
+
+    for node in topo:
+        if node.op is None:  # variable
+            v = _sym_mod.var(node.name)
+            v.attrs.update(node.attrs)
+            v._attr_dict.update(node._attr_dict)
+            rebuilt[node.name] = v
+            continue
+        ins = [lookup(_as_entry(i)) for i in node.inputs]
+        if node.op in _QUANTIZABLE and node.name not in excluded:
+            data_s = ins[0]
+            w_entry = _as_entry(node.inputs[1])
+            w_name = w_entry.name
+            bias_s = ins[2] if len(ins) > 2 else None
+            no_bias = bool(node.attrs.get("no_bias", False)) \
+                or bias_s is None
+
+            # offline weight quantization
+            w_nd = qarg_params.get(w_name)
+            if w_nd is None:
+                raise MXNetError(
+                    f"quantize_model: missing weight param {w_name}")
+            w_np = w_nd.asnumpy() if isinstance(w_nd, NDArray) \
+                else _np.asarray(w_nd)
+            w_absmax = float(max(abs(w_np.min()), abs(w_np.max()), 1e-8))
+            w_q = _np.clip(_np.round(w_np * (127.0 / w_absmax)),
+                           -127, 127).astype(_np.int8)
+            qarg_params[w_name] = _nd.array(w_q, dtype="int8")
+            qarg_params[w_name + "_min"] = _nd.array([-w_absmax])
+            qarg_params[w_name + "_max"] = _nd.array([w_absmax])
+            w_var = rebuilt[w_name]
+            wmin = _sym_mod.var(w_name + "_min")
+            wmax = _sym_mod.var(w_name + "_max")
+            rebuilt.setdefault(w_name + "_min", wmin)
+            rebuilt.setdefault(w_name + "_max", wmax)
+
+            qkw = {}
+            if node.name in calib_ranges:
+                lo, hi = calib_ranges[node.name]
+                qkw = {"min_calib_range": lo, "max_calib_range": hi}
+            qz = _sym_mod.apply_op("_contrib_quantize_v2", data_s,
+                                   name=node.name + "_data_quantize",
+                                   **qkw)
+            qdata, dmin, dmax = qz[0], qz[1], qz[2]
+
+            if not no_bias:
+                # bias is quantized to int8 CODES offline — the
+                # quantized ops' contract (ops/quantization.py) is int8
+                # bias + min/max, mirroring the reference's
+                # quantized-bias inputs
+                b_entry = _as_entry(node.inputs[2])
+                b_nd = qarg_params.get(b_entry.name)
+                b_np = b_nd.asnumpy() if isinstance(b_nd, NDArray) \
+                    else _np.asarray(b_nd)
+                b_absmax = float(max(abs(b_np.min()), abs(b_np.max()),
+                                     1e-8))
+                b_q = _np.clip(_np.round(b_np * (127.0 / b_absmax)),
+                               -127, 127).astype(_np.int8)
+                qarg_params[b_entry.name] = _nd.array(b_q, dtype="int8")
+                from ..symbol.symbol import _scalar_sym
+                bmin = _scalar_sym(-b_absmax)
+                bmax = _scalar_sym(b_absmax)
+            op_attrs = {k: v for k, v in node.attrs.items()
+                        if k not in ("cudnn_tune", "cudnn_off",
+                                     "workspace", "dilate", "layout")}
+            qop = ("_contrib_quantized_conv"
+                   if node.op == "Convolution"
+                   else "_contrib_quantized_fully_connected")
+            if no_bias:
+                qnode = _sym_mod.apply_op(
+                    qop, qdata, w_var, None, dmin, dmax, wmin, wmax,
+                    name=node.name + "_quantized", **op_attrs)
+            else:
+                qnode = _sym_mod.apply_op(
+                    qop, qdata, w_var, bias_s, dmin, dmax, wmin, wmax,
+                    bmin, bmax, name=node.name + "_quantized", **op_attrs)
+            deq = _sym_mod.apply_op(
+                "_contrib_dequantize", qnode[0], qnode[1], qnode[2],
+                name=node.name + "_dequantize")
+            rebuilt[node.name] = deq
+        else:
+            rebuilt[node.name] = _sym_mod.apply_op(
+                node.op, *ins, name=node.name, **node.attrs)
+
+    head = rebuilt[sym.name]
+    qsym = head[sym.out_index] if sym.out_index else head
+    return qsym, qarg_params, dict(aux_params or {})
+
+
+def _as_entry(x):
+    """Inputs may be stored as Symbol entries already."""
+    return x
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 num_calib_examples=None, excluded_sym_names=(),
+                 data_shapes=None, **kwargs):
+    """Gluon front door (reference: quantize_net, ≥1.6): trace the
+    hybridized block to a Symbol, rewrite for int8, return a SymbolBlock
+    running the quantized graph."""
+    from .. import symbol as _sym_mod
+    from ..gluon.block import SymbolBlock
+
+    sym = _sym_mod.trace_block(network)
+    params = network.collect_params()
+    arg_params, aux_params = {}, {}
+    for name, p in params.items():
+        (aux_params if p.grad_req == "null" else arg_params)[name] = \
+            p.data()
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode=calib_mode,
+        calib_data=calib_data, num_calib_examples=num_calib_examples,
+        excluded_sym_names=excluded_sym_names, **kwargs)
+    sb = SymbolBlock(qsym, [_sym_mod.var("data")])
+    all_q = {**qarg, **qaux}
+    for name, p in sb.params.items():
+        if name in all_q:
+            p._load_init(all_q[name], None, cast_dtype=False)
+    return sb
+
+
 def quantize_block(block, calib_data=None, num_calib_batches=5,
                    calib_mode="naive"):
     """Calibrate + mark a gluon block for int8 inference.
@@ -47,14 +344,10 @@ def quantize_block(block, calib_data=None, num_calib_batches=5,
     Returns (block, calib_ranges).  Dense/Conv weights get static ranges
     from their values; activations get ranges from calibration batches.
     """
-    from ..gluon import nn
-
     collector = CalibrationCollector(mode=calib_mode)
-    # weight ranges are static
     for name, param in block.collect_params().items():
         if name.endswith("weight"):
             collector.collect(name, param.data())
-    # activation ranges from calibration data
     if calib_data is not None:
         count = 0
         for batch in calib_data:
@@ -68,17 +361,3 @@ def quantize_block(block, calib_data=None, num_calib_batches=5,
                 break
     block._quant_ranges = dict(collector.ranges)
     return block, collector.ranges
-
-
-def quantize_model(sym, arg_params, aux_params, data_names=("data",),
-                   ctx=None, calib_mode="none", calib_data=None,
-                   num_calib_examples=None, quantized_dtype="int8",
-                   **kwargs):
-    """Symbol-path API shell (reference signature parity).  Graph rewrite
-    of arbitrary symbols into quantized ops is a later milestone; the
-    gluon path (quantize_block) is the supported flow."""
-    raise NotImplementedError(
-        "symbolic quantize_model graph rewriting is not implemented yet; "
-        "use contrib.quantization.quantize_block on a gluon model "
-        "(int8 ops: mx.nd.quantize/quantized_fully_connected/"
-        "quantized_conv)")

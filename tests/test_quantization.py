@@ -1,0 +1,131 @@
+"""int8 quantization flow (reference: tests/python/quantization/
+test_quantization.py — quantize_model/quantize_net int8 conversion)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import quantization as qz
+
+
+def _small_net(rs):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(6))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(rs.randn(4, 3, 8, 8).astype("float32"))
+    net(x)
+    return net, x
+
+
+def test_quantize_net_naive_close_to_float():
+    rs = np.random.RandomState(0)
+    net, x = _small_net(rs)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    calib = [nd.array(rs.randn(4, 3, 8, 8).astype("float32"))
+             for _ in range(3)] + [x]
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode="naive")
+    out = qnet(x).asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+    # weights really are int8 in the quantized block
+    wq = [p for n, p in qnet.params.items()
+          if "conv" in n and n.endswith("_weight")]
+    assert wq and wq[0].data().dtype == np.int8
+
+
+def test_quantize_net_entropy_mode_runs():
+    rs = np.random.RandomState(1)
+    net, x = _small_net(rs)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    calib = [nd.array(rs.randn(8, 3, 8, 8).astype("float32"))
+             for _ in range(4)]
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode="entropy")
+    out = qnet(x).asnumpy()
+    # entropy clips tails: bound MEAN error, not max
+    mean_rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    assert mean_rel < 0.25, mean_rel
+
+
+def test_quantize_model_dynamic_symbol_path():
+    rs = np.random.RandomState(2)
+    net, x = _small_net(rs)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    sym = mx.sym.trace_block(net)
+    args = {n: p.data() for n, p in net.collect_params().items()
+            if p.grad_req != "null"}
+    qsym, qarg, qaux = qz.quantize_model(sym, args, {}, calib_mode="none")
+    feed = {"data": x}
+    feed.update(qarg)
+    feed.update(qaux)
+    out = qsym.eval(**feed).asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_excluded_layers_stay_float():
+    rs = np.random.RandomState(3)
+    net, x = _small_net(rs)
+    sym = mx.sym.trace_block(net)
+    conv_names = [n.name for n in sym._topo() if n.op == "Convolution"]
+    args = {n: p.data() for n, p in net.collect_params().items()
+            if p.grad_req != "null"}
+    qsym, qarg, _ = qz.quantize_model(sym, args, {}, calib_mode="none",
+                                      excluded_sym_names=conv_names)
+    ops = {n.op for n in qsym._topo()}
+    assert "Convolution" in ops  # excluded conv kept float
+    assert "_contrib_quantized_fully_connected" in ops  # fc quantized
+    # excluded layer's weight is still float in qarg
+    wname = [k for k in qarg if "conv" in k and k.endswith("_weight")][0]
+    assert qarg[wname].dtype == np.float32
+
+
+def test_kl_threshold_sane_on_gaussian():
+    from mxnet_tpu.contrib.quantization import _get_optimal_threshold
+
+    rs = np.random.RandomState(0)
+    t = _get_optimal_threshold(rs.randn(50000))
+    assert 2.0 < t < 5.0, t
+
+
+def test_quantize_net_no_bias_convs():
+    # review regression: use_bias=False layers must quantize (resnet-style)
+    rs = np.random.RandomState(4)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, use_bias=False),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(6, use_bias=False))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(rs.randn(4, 3, 8, 8).astype("float32"))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = qnet(x).asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_net_nonzero_bias_preserved():
+    # review regression: the bias contribution must survive quantization
+    rs = np.random.RandomState(5)
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    net.bias.set_data(nd.array(np.array([1.0, -2.0, 0.5, 3.0],
+                                        np.float32)))
+    net.hybridize()
+    x = nd.array(rs.randn(2, 3).astype("float32"))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = qnet(x).asnumpy()
+    assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
