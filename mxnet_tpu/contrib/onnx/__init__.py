@@ -1,0 +1,13 @@
+"""ONNX interop (reference: python/mxnet/contrib/onnx — mx2onnx
+export_model + onnx2mx import_model).
+
+The environment ships no `onnx` package, so the wire format is handled
+directly: `onnx_minimal.proto` is a faithful subset of the public ONNX
+schema (same field numbers), compiled with protoc into
+`onnx_minimal_pb2`.  Files produced here are standard .onnx protobufs
+readable by onnxruntime/netron; files read here must use the ops in the
+support table (the model-zoo CNN family).
+"""
+
+from .mx2onnx import export_model, get_model_proto
+from .onnx2mx import import_model, import_to_gluon

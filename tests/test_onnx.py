@@ -1,0 +1,94 @@
+"""ONNX export/import (reference: tests/python-pytest/onnx/ — the
+mx2onnx/onnx2mx conversion suite, self-contained here because the wire
+format is handled via the checked-in proto subset)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+
+
+def _convnet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_onnx_export_import_roundtrip_convnet(tmp_path):
+    rs = np.random.RandomState(0)
+    net = _convnet()
+    x = nd.array(rs.randn(2, 3, 16, 16).astype("float32"))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x)
+    sym = mx.sym.trace_block(net)
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    path = str(tmp_path / "model.onnx")
+    onnx_mxnet.export_model(sym, params, [(2, 3, 16, 16)],
+                            onnx_file_path=path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    feed = {"data": x}
+    feed.update(arg2)
+    feed.update(aux2)
+    out = sym2.eval(**feed)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_onnx_import_to_gluon(tmp_path):
+    rs = np.random.RandomState(1)
+    net = _convnet()
+    x = nd.array(rs.randn(2, 3, 16, 16).astype("float32"))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x)
+    sym = mx.sym.trace_block(net)
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    path = str(tmp_path / "model.onnx")
+    onnx_mxnet.export_model(sym, params, [(2, 3, 16, 16)],
+                            onnx_file_path=path)
+    sb = onnx_mxnet.import_to_gluon(path)
+    np.testing.assert_allclose(sb(x).asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_onnx_roundtrip_resnet18(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rs = np.random.RandomState(2)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(rs.randn(2, 3, 32, 32).astype("float32"))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x)
+    sym = mx.sym.trace_block(net)
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    path = str(tmp_path / "r18.onnx")
+    onnx_mxnet.export_model(sym, params, [(2, 3, 32, 32)],
+                            onnx_file_path=path)
+    sb = onnx_mxnet.import_to_gluon(path)
+    np.testing.assert_allclose(sb(x).asnumpy(), ref.asnumpy(), atol=1e-5)
+
+
+def test_onnx_proto_is_wire_compatible():
+    """The checked-in proto must keep ONNX's field numbers: a model
+    serialized here parses under the well-known field layout (spot-check
+    via manual varint decode of the graph field tag)."""
+    from mxnet_tpu.contrib.onnx import onnx_minimal_pb2 as pb
+
+    m = pb.ModelProto()
+    m.ir_version = 4
+    m.graph.name = "g"
+    data = m.SerializeToString()
+    # field 1 (ir_version, varint): tag 0x08; field 7 (graph, message):
+    # tag 0x3a — both must appear
+    assert data[0] == 0x08
+    assert 0x3A in data
