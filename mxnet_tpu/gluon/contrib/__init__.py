@@ -2,4 +2,6 @@
 
 from . import nn
 from . import rnn
+from . import moe
 from .estimator import Estimator
+from .moe import MoEFFN

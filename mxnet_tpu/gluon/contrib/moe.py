@@ -1,0 +1,89 @@
+"""Mixture-of-Experts gluon layers (Switch/GShard sparse FFN).
+
+NEW, TPU-first (closes SURVEY.md §2.5's expert-parallel slot): the
+reference has no MoE; this follows the public Switch-Transformer /
+GShard design because its capacity-based dense dispatch is what XLA/TPU
+compiles well.  Expert weights carry an ``ep`` leading axis — under a
+mesh with an ``ep`` dimension (parallel.make_mesh(ep=...)) the
+MOE_EP_RULES sharding places one expert group per ep slice and GSPMD
+derives the dispatch/combine all-to-alls.
+
+Usage::
+
+    ffn = MoEFFN(units=512, hidden=2048, num_experts=8, k=2)
+    rules = parallel.MOE_EP_RULES          # + TP rules if combining
+    trainer = parallel.ShardedTrainer(net, loss, 'adamw', {...},
+                                      mesh=parallel.make_mesh(dp=2, ep=4),
+                                      rules=rules)
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+
+class MoEFFN(HybridBlock):
+    """Sparse MoE feed-forward block: router → top-k dispatch →
+    per-expert FFN → weighted combine (op: ops/moe.py `moe_ffn`).
+
+    Parameters
+    ----------
+    units : int
+        Model width M (input/output features).
+    hidden : int
+        Per-expert FFN hidden width F.
+    num_experts : int
+        Number of experts E.
+    k : int
+        Experts per token (1 = Switch, 2 = GShard top-2).
+    capacity_factor : float
+        Per-expert capacity = ceil(tokens/E · capacity_factor).
+    activation : str
+        'relu' or 'gelu'.
+    """
+
+    def __init__(self, units, hidden, num_experts, k=1,
+                 capacity_factor=1.25, activation="relu", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._hidden = hidden
+        self._num_experts = num_experts
+        self._k = int(k)
+        self._capacity_factor = float(capacity_factor)
+        self._activation = activation
+        self.aux_loss = None
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(num_experts, units))
+            self.expert_w1 = self.params.get(
+                "expert_ffn1_weight", shape=(num_experts, units, hidden))
+            self.expert_b1 = self.params.get(
+                "expert_ffn1_bias", shape=(num_experts, hidden), init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_ffn2_weight", shape=(num_experts, hidden, units))
+            self.expert_b2 = self.params.get(
+                "expert_ffn2_bias", shape=(num_experts, units), init="zeros")
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        out = F.moe_ffn(x, gate_weight, expert_w1, expert_b1, expert_w2,
+                        expert_b2, num_experts=self._num_experts,
+                        k=self._k,
+                        capacity_factor=self._capacity_factor,
+                        activation=self._activation,
+                        output_aux_loss=True)
+        y, aux = out
+        self._stash_aux(aux)
+        return y
+
+    def _stash_aux(self, aux):
+        """Keep the load-balancing loss reachable for the training loop;
+        under a jit trace this is a tracer — callers inside the same
+        trace (e.g. a loss block) may read it, eager callers get the
+        concrete value."""
+        self.aux_loss = aux
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}(units={self._units}, "
+                f"hidden={self._hidden}, experts={self._num_experts}, "
+                f"k={self._k})")

@@ -24,6 +24,7 @@ from . import contrib_det
 from . import quantization
 from . import vision_extra
 from . import legacy_output
+from . import moe
 
 # Re-export every registered pure function at module level so that
 # `from mxnet_tpu import ops; ops.dot(...)` works on jax arrays.  A

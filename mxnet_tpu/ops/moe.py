@@ -1,0 +1,107 @@
+"""Mixture-of-Experts ops (Switch/GShard-style sparse FFN).
+
+NEW, TPU-first (SURVEY.md §2.5 scoped expert parallelism out of v1; this
+closes it): the reference has no MoE — the design here follows the
+public GShard/Switch recipe that TPU systems use, because it is the
+shape XLA compiles well: capacity-based DENSE dispatch (einsum with a
+(tokens, experts, capacity) one-hot) instead of data-dependent gather —
+static shapes, MXU-friendly, and under a mesh the expert dimension of
+the weights shards over the ``ep`` axis so GSPMD inserts the
+token↔expert all-to-alls from annotations alone.
+
+Capacity semantics match Switch Transformers: each expert processes at
+most ``ceil(tokens/experts · capacity_factor)`` tokens; overflow tokens
+pass through the residual (combine weight 0).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _top1_dispatch(probs, capacity):
+    """probs: (N, E) → dispatch (N, E, C) one-hot, combine (N, E, C)."""
+    n, e = probs.shape
+    gate = jnp.max(probs, axis=1)                      # (N,)
+    idx = jnp.argmax(probs, axis=1)                    # (N,)
+    sel = jax.nn.one_hot(idx, e, dtype=probs.dtype)    # (N, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(sel, axis=0) * sel - sel          # (N, E), 0-based
+    pos_tok = jnp.sum(pos, axis=1)                     # (N,)
+    keep = pos_tok < capacity
+    gate = gate * keep.astype(probs.dtype)
+    dispatch = sel[:, :, None] * jax.nn.one_hot(
+        pos_tok, capacity, dtype=probs.dtype)[:, None, :]
+    dispatch = dispatch * keep[:, None, None].astype(probs.dtype)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+@register("moe_ffn", aliases=("MoEFFN_op",))
+def moe_ffn(data, gate_weight, w1, b1, w2, b2, num_experts=None, k=1,
+            capacity_factor=1.25, activation="relu",
+            output_aux_loss=False):
+    """Sparse MoE FFN: route → dispatch → per-expert FFN → combine.
+
+    data: (..., M); gate_weight: (E, M) (FullyConnected layout);
+    w1: (E, M, F); b1: (E, F); w2: (E, F, M); b2: (E, M).
+    Returns y (same shape as data); with output_aux_loss also returns
+    the Switch load-balancing loss  E · Σ_e f_e · p̄_e  (scalar).
+    """
+    orig_shape = data.shape
+    m = orig_shape[-1]
+    x = data.reshape(-1, m)
+    n = x.shape[0]
+    e = gate_weight.shape[0]
+    capacity = max(1, int(math.ceil(n / e * capacity_factor)))
+
+    logits = jnp.einsum("nm,em->ne", x.astype(jnp.float32),
+                        gate_weight.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    dispatch = jnp.zeros((n, e, capacity), probs.dtype)
+    combine = jnp.zeros((n, e, capacity), probs.dtype)
+    masked = probs
+    for _ in range(int(k)):
+        d_i, c_i = _top1_dispatch(masked, capacity)
+        dispatch = jnp.maximum(dispatch, d_i)
+        combine = combine + c_i
+        # mask out the chosen expert for the next pick
+        chosen = jnp.sum(d_i, axis=2)  # (N, E) 0/1
+        masked = masked * (1.0 - chosen)
+    if k > 1:
+        # renormalize combine weights over the k picks (GShard top-2)
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+    dispatch = dispatch.astype(data.dtype)
+    combine = combine.astype(data.dtype)
+
+    expert_in = jnp.einsum("nec,nm->ecm", dispatch, x)
+    h = jnp.einsum("ecm,emf->ecf", expert_in, w1,
+                   preferred_element_type=jnp.float32).astype(data.dtype)
+    h = h + b1[:, None, :]
+    if activation == "relu":
+        h = jnp.maximum(h, 0)
+    elif activation == "gelu":
+        h = jax.nn.gelu(h)
+    out_e = jnp.einsum("ecf,efm->ecm", h, w2,
+                       preferred_element_type=jnp.float32) \
+        .astype(data.dtype)
+    out_e = out_e + b2[:, None, :]
+    y = jnp.einsum("nec,ecm->nm", combine, out_e).reshape(orig_shape)
+
+    if not output_aux_loss:
+        return y
+    # Switch aux loss: fraction of tokens per expert × mean router prob
+    sel1 = jax.nn.one_hot(jnp.argmax(probs, axis=1), e,
+                          dtype=jnp.float32)
+    f = jnp.mean(sel1, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p)
+    return y, aux.astype(data.dtype)

@@ -17,7 +17,8 @@ from . import mesh
 from .mesh import (DP, EP, PP, SP, TP, data_parallel_mesh, default_mesh,
                    make_mesh, set_default_mesh)
 from . import sharding
-from .sharding import ShardingRules, TRANSFORMER_TP_RULES, annotate_block
+from .sharding import (MOE_EP_RULES, ShardingRules, TRANSFORMER_TP_RULES,
+                       annotate_block, combined_rules)
 from . import ring
 from .ring import ring_attention, ulysses_attention
 from . import pipeline

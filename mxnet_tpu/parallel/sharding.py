@@ -53,6 +53,26 @@ TRANSFORMER_TP_RULES = ShardingRules(rules=[
     (r"word_embed_weight$|embedding\d*_weight$", (TP, None)),
 ], default=())
 
+# expert parallelism: MoE expert weights shard on their leading E axis
+# (gluon/contrib/moe.py MoEFFN); the router gate stays replicated so
+# every ep slice routes identically
+from .mesh import EP  # noqa: E402
+
+MOE_EP_RULES = ShardingRules(rules=[
+    (r"expert_ffn\d_weight$", (EP, None, None)),
+    (r"expert_ffn\d_bias$", (EP, None)),
+], default=())
+
+
+def combined_rules(*rule_sets):
+    """Merge rule sets (first match wins across the concatenation) —
+    e.g. combined_rules(TRANSFORMER_TP_RULES, MOE_EP_RULES) for a
+    tp×ep transformer."""
+    merged = ShardingRules()
+    for rs in rule_sets:
+        merged._rules.extend(rs._rules)
+    return merged
+
 
 def annotate_block(block, rules):
     """Stamp partition_spec onto every Parameter of a block (consumed by

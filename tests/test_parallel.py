@@ -533,3 +533,123 @@ def test_hybridize_remat_matches():
         grads.append(net[0].weight.grad().asnumpy())
     np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
     np.testing.assert_allclose(grads[0], grads[1], rtol=1e-6)
+
+
+# -- Mixture of Experts + expert parallelism -----------------------------------
+# (SURVEY §2.5 ep slot; design follows public Switch/GShard recipe)
+
+def test_moe_ffn_top1_matches_dense_oracle():
+    """With capacity ≥ tokens, top-1 MoE == per-token expert FFN chosen
+    by argmax of the router."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.moe import moe_ffn
+
+    rs = np.random.RandomState(0)
+    n, m, f, e = 12, 8, 16, 4
+    x = jnp.asarray(rs.randn(n, m).astype(np.float32))
+    gw = jnp.asarray(rs.randn(e, m).astype(np.float32))
+    w1 = jnp.asarray(rs.randn(e, m, f).astype(np.float32) * 0.1)
+    b1 = jnp.asarray(rs.randn(e, f).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rs.randn(e, f, m).astype(np.float32) * 0.1)
+    b2 = jnp.asarray(rs.randn(e, m).astype(np.float32) * 0.1)
+
+    y = np.asarray(moe_ffn(x, gw, w1, b1, w2, b2, num_experts=e, k=1,
+                           capacity_factor=float(n)))  # no overflow
+    # numpy oracle
+    logits = np.asarray(x) @ np.asarray(gw).T
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    idx = probs.argmax(1)
+    expect = np.zeros((n, m), np.float32)
+    for t in range(n):
+        ei = idx[t]
+        h = np.maximum(np.asarray(x)[t] @ np.asarray(w1)[ei]
+                       + np.asarray(b1)[ei], 0)
+        expect[t] = probs[t, ei] * (h @ np.asarray(w2)[ei]
+                                    + np.asarray(b2)[ei])
+    np.testing.assert_allclose(y, expect, atol=1e-4)
+
+
+def test_moe_ffn_capacity_drops_overflow():
+    """Tokens beyond an expert's capacity combine to zero (pass-through
+    slot for the residual), Switch semantics."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.moe import moe_ffn
+
+    n, m, e = 8, 4, 2
+    # router forces every token onto expert 0
+    x = jnp.ones((n, m), jnp.float32)
+    gw = jnp.asarray(np.array([[5.0] * m, [-5.0] * m], np.float32))
+    w1 = jnp.ones((e, m, 4), jnp.float32)
+    b1 = jnp.zeros((e, 4), jnp.float32)
+    w2 = jnp.ones((e, 4, m), jnp.float32)
+    b2 = jnp.zeros((e, m), jnp.float32)
+    # capacity_factor 1.0 -> capacity ceil(8/2)=4: only 4 tokens served
+    y = np.asarray(moe_ffn(x, gw, w1, b1, w2, b2, num_experts=e, k=1,
+                           capacity_factor=1.0))
+    served = (np.abs(y).sum(axis=1) > 0).sum()
+    assert served == 4, served
+
+
+def test_moe_gluon_layer_trains_and_balances():
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.contrib import MoEFFN
+
+    rs = np.random.RandomState(1)
+    layer = MoEFFN(units=8, hidden=16, num_experts=4, k=2,
+                   capacity_factor=2.0)
+    layer.initialize(init=mx.init.Xavier())
+    x = nd.array(rs.randn(16, 8).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = layer(x)
+        loss = (y * y).sum()
+    loss.backward()
+    assert y.shape == x.shape
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+    g = layer.expert_w1.grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+    # aux loss populated and >= 1 (1.0 == perfectly balanced)
+    assert layer.aux_loss is not None
+    assert float(nd.array(layer.aux_loss).asnumpy()) >= 0.99
+
+
+def test_moe_expert_parallel_step_matches_single_device():
+    """dp×ep sharded whole-step training == unsharded training (GSPMD
+    collectives must not change the math)."""
+    import jax
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.contrib import MoEFFN
+
+    rs = np.random.RandomState(2)
+    x = rs.randn(16, 8).astype("float32")
+    y = rs.randn(16, 8).astype("float32")
+
+    def build():
+        mx.random.seed(7)
+        net = gluon.nn.HybridSequential()
+        net.add(MoEFFN(units=8, hidden=16, num_experts=4, k=1,
+                       capacity_factor=4.0))
+        net.initialize(init=mx.init.Xavier())
+        net(mx.nd.array(x))  # materialize
+        return net
+
+    losses = {}
+    for name, mesh, rules in [
+            ("single", parallel.make_mesh(dp=1), None),
+            ("dp2ep4", parallel.make_mesh(dp=2, ep=4),
+             parallel.MOE_EP_RULES)]:
+        net = build()
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.L2Loss(), "sgd", {"learning_rate": 0.1},
+            mesh=mesh, rules=rules)
+        ls = [float(np.asarray(tr.step(mx.nd.array(x),
+                                       mx.nd.array(y))._data,
+                               dtype=np.float32))
+              for _ in range(3)]
+        losses[name] = ls
+    np.testing.assert_allclose(losses["single"], losses["dp2ep4"],
+                               rtol=2e-4)
