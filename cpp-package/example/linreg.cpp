@@ -1,0 +1,90 @@
+/*
+ * Train 1D linear regression through the C++ binding ONLY — no Python
+ * source in this program (reference analog: cpp-package/example/
+ * mlp_cpu.cpp driving c_api.h).
+ *
+ * Build (from repo root; libmxtpu.so built by `make -C src`):
+ *   g++ -std=c++17 cpp-package/example/linreg.cpp \
+ *       -Icpp-package/include/mxnet-tpu-cpp -Isrc \
+ *       -Lsrc -lmxtpu -Wl,-rpath,$PWD/src -o /tmp/linreg_cpp
+ *   PYTHONPATH=$PWD /tmp/linreg_cpp
+ */
+#include <cstdio>
+#include <cmath>
+#include <vector>
+
+#include "ndarray.hpp"
+
+using mxtpu::cpp::AutogradRecord;
+using mxtpu::cpp::Backward;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Operator;
+
+int main() {
+  mxtpu::cpp::Init();
+
+  // y = 3x - 1
+  std::vector<float> xs, ys;
+  for (int i = 0; i < 32; ++i) {
+    float x = static_cast<float>(i) / 8.0f - 2.0f;
+    xs.push_back(x);
+    ys.push_back(3.0f * x - 1.0f);
+  }
+  NDArray x(xs, {32, 1});
+  NDArray y(ys, {32, 1});
+  NDArray w(std::vector<float>{0.0f}, {1, 1});
+  NDArray b(std::vector<float>{0.0f}, {1});
+  w.AttachGrad();
+  b.AttachGrad();
+
+  float lr = 0.2f;
+  for (int step = 0; step < 60; ++step) {
+    NDArray loss;
+    {
+      AutogradRecord rec;
+      auto wx = Operator("dot").AddInput(x).AddInput(w).Invoke();
+      auto pred = Operator("broadcast_add")
+                      .AddInput(wx[0])
+                      .AddInput(b)
+                      .Invoke();
+      auto diff = Operator("broadcast_sub")
+                      .AddInput(pred[0])
+                      .AddInput(y)
+                      .Invoke();
+      auto sq = Operator("square").AddInput(diff[0]).Invoke();
+      auto m = Operator("mean").AddInput(sq[0]).Invoke();
+      loss = std::move(m[0]);
+    }
+    Backward(loss);
+    // SGD via the fused optimizer op, still C-surface only; the op
+    // returns the updated weight (reference semantics would write
+    // through out=, which the flat invoke surface expresses as output 0)
+    auto wg = w.Grad();
+    auto bg = b.Grad();
+    auto w2 = Operator("sgd_update")
+                  .AddInput(w)
+                  .AddInput(wg)
+                  .SetParam("lr", std::to_string(lr))
+                  .Invoke();
+    auto b2 = Operator("sgd_update")
+                  .AddInput(b)
+                  .AddInput(bg)
+                  .SetParam("lr", std::to_string(lr))
+                  .Invoke();
+    w = std::move(w2[0]);
+    b = std::move(b2[0]);
+    w.AttachGrad();
+    b.AttachGrad();
+  }
+
+  float wf = w.ToVector()[0];
+  float bf = b.ToVector()[0];
+  std::printf("w=%.4f b=%.4f\n", wf, bf);
+  if (std::fabs(wf - 3.0f) > 0.05f || std::fabs(bf + 1.0f) > 0.05f) {
+    std::printf("FAIL\n");
+    return 1;
+  }
+  std::printf("PASS\n");
+  MXTPUShutdown();
+  return 0;
+}

@@ -1,0 +1,147 @@
+/*
+ * mxnet-tpu-cpp — header-only C++ frontend over the flat C ABI.
+ *
+ * Reference parity: cpp-package/include/mxnet-cpp/ (NDArray, Operator) —
+ * the reference's C++ binding is a thin RAII/operator layer over
+ * c_api.h; this is the same layer over mxtpu_c_api.h.  Proof-of-design
+ * for SURVEY §2.4 "other-language bindings": nothing here knows about
+ * Python or JAX, only the C handles.
+ */
+#ifndef MXNET_TPU_CPP_NDARRAY_HPP_
+#define MXNET_TPU_CPP_NDARRAY_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu_c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+/* Boot (or attach to) the runtime once per process. */
+inline void Init() { Check(MXTPUInit()); }
+
+class NDArray {
+ public:
+  NDArray() : h_(nullptr) {}
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+
+  NDArray(const std::vector<float> &data,
+          const std::vector<int64_t> &shape) {
+    Check(MXNDArrayCreate(data.data(), data.size() * sizeof(float),
+                          shape.data(), static_cast<int>(shape.size()),
+                          "float32", &h_));
+  }
+
+  ~NDArray() {
+    if (h_) MXNDArrayFree(h_);
+  }
+
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) {
+      if (h_) MXNDArrayFree(h_);
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+
+  NDArrayHandle handle() const { return h_; }
+  bool is_none() const { return h_ == nullptr; }
+
+  std::vector<int64_t> Shape() const {
+    int ndim = 0;
+    int64_t dims[8];
+    Check(MXNDArrayGetShape(h_, &ndim, dims));
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 0;
+    Check(MXNDArraySize(h_, &n));
+    return n;
+  }
+
+  /* Blocking device->host copy (the reference's SyncCopyToCPU). */
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size() / sizeof(float));
+    Check(MXNDArraySyncCopyToCPU(h_, out.data(),
+                                 out.size() * sizeof(float)));
+    return out;
+  }
+
+  void AttachGrad() { Check(MXAutogradAttachGrad(h_)); }
+
+  NDArray Grad() const {
+    NDArrayHandle g = nullptr;
+    Check(MXNDArrayGetGrad(h_, &g));
+    return NDArray(g);
+  }
+
+ private:
+  NDArrayHandle h_;
+};
+
+/* Operator invocation builder (reference: mxnet-cpp Operator). */
+class Operator {
+ public:
+  explicit Operator(std::string name) : name_(std::move(name)) {}
+
+  Operator &AddInput(const NDArray &a) {
+    inputs_.push_back(a.handle());
+    return *this;
+  }
+
+  Operator &SetParam(const std::string &k, const std::string &v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
+    return *this;
+  }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<const char *> ks, vs;
+    for (auto &k : keys_) ks.push_back(k.c_str());
+    for (auto &v : vals_) vs.push_back(v.c_str());
+    NDArrayHandle outs[8] = {nullptr};
+    int n_out = 8;
+    Check(MXImperativeInvoke(name_.c_str(), inputs_.data(),
+                             static_cast<int>(inputs_.size()),
+                             ks.data(), vs.data(),
+                             static_cast<int>(ks.size()), outs, &n_out));
+    std::vector<NDArray> result;
+    result.reserve(n_out);
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  std::string name_;
+  std::vector<NDArrayHandle> inputs_;
+  std::vector<std::string> keys_, vals_;
+};
+
+/* Autograd scope (reference: mxnet-cpp autograd record). */
+class AutogradRecord {
+ public:
+  AutogradRecord() { Check(MXAutogradRecordStart()); }
+  ~AutogradRecord() { MXAutogradRecordStop(); }
+};
+
+inline void Backward(const NDArray &loss) {
+  Check(MXAutogradBackward(loss.handle()));
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_NDARRAY_HPP_

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Deployment pipeline: train → export (symbol.json + .params) →
+re-import with SymbolBlock → int8 post-training quantization → ONNX.
+
+Reference analogs: example/image-classification's save/load flow,
+example/quantization/imagenet_gen_qsym.py, and the contrib.onnx export
+tutorial — composed into the one deployment story.
+
+Run:  python examples/deploy_export_quantize.py [--out-dir /tmp/deploy]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib import quantization as qz
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/mxtpu_deploy")
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    rs = np.random.RandomState(0)
+
+    # 1. a small convnet, trained briefly on synthetic data
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(rs.randn(32, 3, 16, 16).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, 32).astype("float32"))
+    for i in range(args.steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(32)
+    print(f"trained {args.steps} steps, loss "
+          f"{float(loss.mean().asnumpy()):.4f}")
+
+    # 2. export the deploy format (reference: HybridBlock.export)
+    prefix = os.path.join(args.out_dir, "model")
+    net.export(prefix)
+    print(f"exported {prefix}-symbol.json + {prefix}-0000.params")
+
+    # 3. reload WITHOUT the python class (reference: SymbolBlock.imports)
+    deployed = gluon.SymbolBlock.imports(
+        f"{prefix}-symbol.json", ["data"], f"{prefix}-0000.params")
+    with autograd.predict_mode():
+        ref = net(x)
+    drift = float(abs(deployed(x).asnumpy() - ref.asnumpy()).max())
+    print(f"SymbolBlock reload drift: {drift:.2e}")
+
+    # 4. int8 post-training quantization with entropy calibration
+    calib = [mx.nd.array(rs.randn(32, 3, 16, 16).astype("float32"))
+             for _ in range(4)]
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode="naive")
+    qdrift = float(abs(qnet(x).asnumpy() - ref.asnumpy()).max())
+    print(f"int8 max drift: {qdrift:.3f} "
+          f"(scale {float(abs(ref.asnumpy()).max()):.3f})")
+
+    # 5. ONNX for everything else (reference: contrib.onnx export_model)
+    sym = mx.sym.trace_block(net)
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    onnx_path = onnx_mxnet.export_model(
+        sym, params, [(32, 3, 16, 16)],
+        onnx_file_path=os.path.join(args.out_dir, "model.onnx"))
+    back = onnx_mxnet.import_to_gluon(onnx_path)
+    odrift = float(abs(back(x).asnumpy() - ref.asnumpy()).max())
+    print(f"ONNX round-trip drift: {odrift:.2e}")
+    assert drift < 1e-4 and odrift < 1e-4
+    print("deploy pipeline OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,11 @@ A from-scratch rebuild of the capabilities of apache/incubator-mxnet
 
 __version__ = "0.1.0"
 
+# memory-pool env knobs must hit the XLA client env BEFORE jax loads
+# (reference analog: pool env read at Storage::Get())
+from . import storage
+storage.apply_env()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

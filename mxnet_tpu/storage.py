@@ -1,0 +1,76 @@
+"""Device-memory management surface.
+
+Reference parity: src/storage/ (pooled_storage_manager.h) and the
+`MXNET_GPU_MEM_POOL_*` env plane.  On TPU the allocator IS the PJRT
+client (best-fit + BFC arena inside libtpu), so the reference's
+hand-rolled pool is replaced by knobs that configure that client plus
+introspection over its live statistics:
+
+- `MXNET_TPU_MEM_FRACTION`   → XLA_PYTHON_CLIENT_MEM_FRACTION
+  (reference analog: MXNET_GPU_MEM_POOL_RESERVE, inverted — fraction to
+  USE rather than reserve)
+- `MXNET_TPU_PREALLOCATE`    → XLA_PYTHON_CLIENT_PREALLOCATE
+  (reference analog: pooled vs naive storage manager — preallocating is
+  the pooled behavior)
+- `MXNET_TPU_ALLOCATOR`      → XLA_PYTHON_CLIENT_ALLOCATOR
+  (`platform` = naive per-buffer alloc, like MXNET_GPU_MEM_POOL_TYPE=Naive)
+
+`apply_env()` runs at package import, BEFORE jax initializes, so the
+knobs take effect the same way the reference reads its pool env at
+Storage::Get() construction.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV_MAP = [
+    ("MXNET_TPU_MEM_FRACTION", "XLA_PYTHON_CLIENT_MEM_FRACTION"),
+    ("MXNET_TPU_PREALLOCATE", "XLA_PYTHON_CLIENT_PREALLOCATE"),
+    ("MXNET_TPU_ALLOCATOR", "XLA_PYTHON_CLIENT_ALLOCATOR"),
+]
+
+
+def apply_env():
+    """Map MXNET_* memory knobs onto the XLA client env (no-op for
+    already-set XLA vars: explicit XLA config wins)."""
+    for src, dst in _ENV_MAP:
+        if src in os.environ and dst not in os.environ:
+            os.environ[dst] = os.environ[src]
+
+
+def memory_info(ctx=None):
+    """(free_bytes, total_bytes) for a device — reference:
+    mx.context.gpu_memory_info (MXGetGPUMemoryInformation64).  Returns
+    (None, None) when the backend exposes no stats (CPU)."""
+    import jax
+
+    if ctx is not None and hasattr(ctx, "_jax_device"):
+        dev = ctx._jax_device()
+    else:
+        idx = getattr(ctx, "device_id", 0) if ctx is not None else 0
+        devs = jax.local_devices()
+        dev = devs[min(idx, len(devs) - 1)]
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return (None, None)
+    if not stats:
+        return (None, None)
+    total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    used = stats.get("bytes_in_use", 0)
+    if total is None:
+        return (None, None)
+    return (int(total) - int(used), int(total))
+
+
+def memory_summary(ctx=None):
+    """Human-readable allocator statistics (reference analog: the
+    storage profiler dump)."""
+    free, total = memory_info(ctx)
+    if total is None:
+        return "device exposes no memory statistics"
+    used = total - free
+    return (f"used {used / 2**20:.1f} MiB / {total / 2**20:.1f} MiB "
+            f"({100.0 * used / total:.1f}%)")

@@ -237,3 +237,33 @@ def test_c_frontend_smoke(tmp_path):
                        text=True, timeout=240)
     assert r.returncode == 0, (r.stdout, r.stderr[-500:])
     assert "C_SMOKE_OK" in r.stdout
+
+
+def test_cpp_package_linreg_example(capi):
+    """The C++ binding (cpp-package/) trains linear regression through
+    the C ABI only — the reference's cpp-package/example analog."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    src = os.path.join(ROOT, "cpp-package", "example", "linreg.cpp")
+    inc = os.path.join(ROOT, "cpp-package", "include", "mxnet-tpu-cpp")
+    binp = os.path.join(ROOT, "src", ".linreg_cpp_test")
+    r = subprocess.run(
+        ["g++", "-std=c++17", src, f"-I{inc}",
+         f"-I{os.path.join(ROOT, 'src')}",
+         f"-L{os.path.join(ROOT, 'src')}", "-lmxtpu",
+         f"-Wl,-rpath,{os.path.join(ROOT, 'src')}", "-o", binp],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        run = subprocess.run([binp], capture_output=True, text=True,
+                             env=env, timeout=240)
+        assert run.returncode == 0, (run.stdout[-300:], run.stderr[-300:])
+        assert "PASS" in run.stdout
+    finally:
+        if os.path.exists(binp):
+            os.remove(binp)
