@@ -308,6 +308,49 @@ class SaturationJitterAug(Augmenter):
         return _to_nd(arr * alpha + gray * (1.0 - alpha))
 
 
+class RandomGrayAug(Augmenter):
+    """Convert to 3-channel grayscale with probability p (reference:
+    mx.image.RandomGrayAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = _np.array([[0.21, 0.21, 0.21],
+                              [0.72, 0.72, 0.72],
+                              [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _to_nd(_np.dot(_to_np(src).astype(_np.float32),
+                                  self.mat))
+        return src
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference: mx.image.HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]])
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]])
+        t = _np.dot(_np.dot(self.ityiq, bt), self.tyiq).T
+        arr = _to_np(src).astype(_np.float32)
+        return _to_nd(_np.dot(arr, t))
+
+
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=mean, std=std)
@@ -467,3 +510,12 @@ class ImageIter:
             label=[_from_jax(jnp.asarray(
                 label[:, 0] if self.label_width == 1 else label))],
             pad=0)
+
+
+# detection pipeline (reference: python/mxnet/image/detection.py) —
+# imported at module tail to avoid the circular import with
+# image_detection's `from .image import ...`
+from .image_detection import (CreateDetAugmenter, DetAugmenter,  # noqa: E402
+                              DetBorrowAug, DetHorizontalFlipAug,
+                              DetRandomCropAug, DetRandomPadAug,
+                              DetRandomSelectAug, ImageDetIter)

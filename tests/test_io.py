@@ -424,3 +424,121 @@ def test_vision_transforms():
     comp = transforms.Compose([transforms.Resize(8),
                                transforms.ToTensor()])
     assert comp(img).shape == (3, 8, 8)
+
+
+# -- detection pipeline (reference: python/mxnet/image/detection.py) -----------
+
+def _make_det_list(tmp_path, n=8):
+    from PIL import Image
+
+    rs = np.random.RandomState(0)
+    lines = []
+    for i in range(n):
+        arr = (rs.rand(40, 50, 3) * 255).astype(np.uint8)
+        Image.fromarray(arr).save(str(tmp_path / f"img{i}.jpg"))
+        objs = [[1.0, 0.1, 0.2, 0.6, 0.7]]
+        if i % 2:
+            objs.append([0.0, 0.3, 0.3, 0.9, 0.9])
+        flat = [2, 5] + [v for o in objs for v in o]
+        lines.append(f"{i}\t" + "\t".join(str(v) for v in flat)
+                     + f"\timg{i}.jpg")
+    lst = tmp_path / "det.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    return str(lst)
+
+
+def test_image_det_iter_batches(tmp_path):
+    from mxnet_tpu import image
+
+    lst = _make_det_list(tmp_path)
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imglist=lst, path_root=str(tmp_path))
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4, 2, 5)
+    valid = lab[lab[:, :, 0] >= 0]
+    assert len(valid) >= 4  # at least one object per image
+    assert (valid[:, 1:5] >= 0).all() and (valid[:, 1:5] <= 1).all()
+    # -1 padding rows where images have fewer objects
+    assert (lab[:, :, 0] == -1).any()
+
+
+def test_det_horizontal_flip_flips_boxes():
+    from mxnet_tpu.image_detection import DetHorizontalFlipAug
+
+    src = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+    label = np.array([[1.0, 0.1, 0.2, 0.4, 0.7]], np.float32)
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab2 = aug(src, label)
+    np.testing.assert_allclose(lab2[0, 1], 0.6, atol=1e-6)  # 1-0.4
+    np.testing.assert_allclose(lab2[0, 3], 0.9, atol=1e-6)  # 1-0.1
+    np.testing.assert_array_equal(np.asarray(out), src[:, ::-1])
+
+
+def test_det_random_crop_keeps_covered_objects():
+    from mxnet_tpu.image_detection import DetRandomCropAug
+
+    np.random.seed(0)
+    src = np.zeros((100, 100, 3), np.uint8)
+    label = np.array([[0.0, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.9,
+                           area_range=(0.5, 1.0),
+                           min_eject_coverage=0.5, max_attempts=100)
+    out, lab2 = aug(src, label)
+    # surviving boxes stay normalized and inside the crop
+    if lab2.size:
+        assert (lab2[:, 1:5] >= 0).all() and (lab2[:, 1:5] <= 1).all()
+
+
+def test_det_augmenter_pipeline_runs(tmp_path):
+    from mxnet_tpu import image
+
+    lst = _make_det_list(tmp_path)
+    augs = image.CreateDetAugmenter(data_shape=(3, 32, 32),
+                                    rand_crop=0.5, rand_pad=0.5,
+                                    rand_mirror=True, brightness=0.2,
+                                    contrast=0.2, saturation=0.2,
+                                    hue=0.1,
+                                    mean=np.array([123., 117., 104.]),
+                                    std=np.array([58., 57., 57.]))
+    it = image.ImageDetIter(batch_size=8, data_shape=(3, 32, 32),
+                            path_imglist=lst, path_root=str(tmp_path),
+                            aug_list=augs, shuffle=True)
+    batch = next(it)
+    assert batch.data[0].shape == (8, 3, 32, 32)
+    # normalized pixel stats in a sane range
+    d = batch.data[0].asnumpy()
+    assert np.abs(d).max() < 10
+
+
+def test_det_random_crop_rejects_truncating_crops():
+    """Reference semantics (review finding): every INTERSECTING object
+    must meet min_object_covered — a crop that truncates one box below
+    the constraint is rejected even if another box is fully covered."""
+    from mxnet_tpu.image_detection import DetRandomCropAug
+
+    aug = DetRandomCropAug(min_object_covered=0.95,
+                           min_eject_coverage=0.3)
+    label = np.array([[0.0, 0.05, 0.05, 0.5, 0.5],
+                      [1.0, 0.4, 0.4, 0.95, 0.95]], np.float32)
+    # crop covering box0 fully, box1 ~31%: must NOT be accepted
+    crop = (0.0, 0.0, 0.55, 0.55)
+    from mxnet_tpu.image_detection import _box_iou_coverage
+
+    cov = _box_iou_coverage(crop, label)
+    inter = cov > 0
+    assert not (inter.any()
+                and cov[inter].min() >= aug.min_object_covered)
+
+
+def test_det_parse_label_rejects_malformed():
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.image_detection import ImageDetIter
+
+    with pytest.raises(MXNetError):
+        ImageDetIter._parse_label(
+            np.array([2, 5, 1.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7],
+                     np.float32)[: -1])  # 7-value body, ow=5
