@@ -136,3 +136,75 @@ def kv_pull(kid, key):
 
 def kv_free(kid):
     _KVSTORES.pop(kid, None)
+
+
+# -- predictor (reference: c_predict_api.h / c_predict_api.cc) -----------------
+
+_PREDICTORS = {}
+_NEXT_PRED = [1]
+
+
+def pred_create(symbol_json, param_bytes, input_names):
+    """symbol.json text + .params file bytes + input names -> handle.
+    The deploy-format predictor: builds a SymbolBlock exactly like
+    gluon.SymbolBlock.imports but from in-memory buffers (the
+    reference's amalgamation/predict use case)."""
+    import os
+    import tempfile
+
+    from . import symbol as sym_mod
+    from .gluon.block import SymbolBlock
+
+    sym = sym_mod.fromjson(symbol_json)
+    names = [str(n) for n in input_names]
+    inputs = [sym_mod.var(n) for n in names]
+    block = SymbolBlock(sym, inputs)
+    if param_bytes:
+        fd, path = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(bytes(param_bytes))
+            block.collect_params().load(path, cast_dtype=True,
+                                        dtype_source="saved",
+                                        allow_missing=False,
+                                        ignore_extra=True)
+        finally:
+            os.remove(path)
+    pid = _NEXT_PRED[0]
+    _NEXT_PRED[0] += 1
+    _PREDICTORS[pid] = {"block": block, "inputs": {}, "names": names,
+                        "outputs": None}
+    return pid
+
+
+def pred_set_input(pid, key, buf, shape):
+    from . import ndarray as nd
+
+    p = _PREDICTORS[pid]
+    arr = np.frombuffer(bytes(buf), dtype=np.float32).reshape(
+        tuple(shape)).copy()
+    p["inputs"][str(key)] = nd.array(arr)
+
+
+def pred_forward(pid):
+    from . import autograd
+
+    p = _PREDICTORS[pid]
+    args = [p["inputs"][n] for n in p["names"]]
+    with autograd.predict_mode():
+        out = p["block"](*args)
+    p["outputs"] = list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def pred_output_shape(pid, index):
+    p = _PREDICTORS[pid]
+    return tuple(int(d) for d in p["outputs"][int(index)].shape)
+
+
+def pred_get_output(pid, index):
+    p = _PREDICTORS[pid]
+    return p["outputs"][int(index)].astype("float32").asnumpy().tobytes()
+
+
+def pred_free(pid):
+    _PREDICTORS.pop(int(pid), None)

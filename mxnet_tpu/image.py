@@ -399,6 +399,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(ContrastJitterAug(contrast))
     if saturation:
         auglist.append(SaturationJitterAug(saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if pca_noise > 0:
         eigval = _np.array([55.46, 4.794, 1.148])
         eigvec = _np.array([[-0.5675, 0.7192, 0.4009],

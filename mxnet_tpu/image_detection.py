@@ -271,12 +271,18 @@ class ImageDetIter(ImageIter):
         # scan labels once for (max_objects, object_width)
         self._obj_width = None
         max_obj = 1
-        for kind, item in self._items:
+        for idx, (kind, item) in enumerate(self._items):
             lab = self._raw_label(kind, item)
             objs = self._parse_label(lab)
             max_obj = max(max_obj, objs.shape[0])
-            if self._obj_width is None and objs.size:
-                self._obj_width = objs.shape[1]
+            if objs.size:
+                if self._obj_width is None:
+                    self._obj_width = objs.shape[1]
+                elif objs.shape[1] != self._obj_width:
+                    raise MXNetError(
+                        f"ImageDetIter: record {idx} has object width "
+                        f"{objs.shape[1]} but the dataset started with "
+                        f"{self._obj_width} — mixed widths cannot batch")
         self._obj_width = self._obj_width or 5
         self._max_obj = max_obj
 
@@ -297,6 +303,10 @@ class ImageDetIter(ImageIter):
                              "packed detection format")
         hw = int(raw[0])
         ow = int(raw[1])
+        if hw < 2 or hw > raw.size:
+            raise MXNetError(
+                f"ImageDetIter: header width {hw} invalid for a "
+                f"{raw.size}-value packed label (must be in [2, size])")
         if ow < 5:
             raise MXNetError(f"ImageDetIter: object width {ow} < 5")
         body = raw[hw:]

@@ -1,0 +1,98 @@
+"""Runtime kernel compilation (reference: python/mxnet/rtc.py —
+CudaModule/CudaKernel over NVRTC, src/common/rtc.cc).
+
+TPU-first redesign: the runtime-compiled-kernel facility on TPU is
+Pallas (Mosaic), not NVRTC.  ``PallasModule`` takes a python source
+string defining pallas kernels, compiles it at runtime, and exposes
+get_kernel with the reference's launch-style call signature.
+``CudaModule`` remains as an API shim that raises with guidance, so
+ported scripts fail with an actionable message instead of an
+AttributeError.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+
+class CudaModule:
+    """Reference signature shim.  CUDA source cannot target the MXU;
+    port kernels to Pallas and use PallasModule."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "mx.rtc.CudaModule compiles CUDA C, which has no TPU "
+            "target.  Port the kernel to Pallas and use "
+            "mx.rtc.PallasModule(source, exports=[...]) — the kernel "
+            "body keeps the same grid/block mental model "
+            "(pl.program_id, BlockSpecs) on the MXU/VPU.")
+
+
+class PallasKernel:
+    """One compiled pallas kernel (reference analog: CudaKernel)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Reference CudaKernel.launch signature; grid/block dims are
+        advisory on TPU (the kernel's own BlockSpecs/grid govern)."""
+        from .ndarray.ndarray import NDArray, _from_jax
+
+        raw = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*raw)
+        if isinstance(out, (tuple, list)):
+            return [_from_jax(o) for o in out]
+        return _from_jax(out)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """Compile python source containing jax/pallas kernels at runtime.
+
+    source: python code; exports: names of callables to expose.  Each
+    exported callable takes/returns jax arrays (wrap pl.pallas_call
+    inside).  Example::
+
+        src = '''
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _add1(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        def add_one(x):
+            return pl.pallas_call(
+                _add1, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True)(x)
+        '''
+        mod = mx.rtc.PallasModule(src, exports=['add_one'])
+        y = mod.get_kernel('add_one').launch([x])
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        self._namespace = {}
+        try:
+            exec(compile(source, "<rtc.PallasModule>", "exec"),
+                 self._namespace)
+        except Exception as e:
+            raise MXNetError(f"PallasModule compilation failed: {e}")
+        self._exports = list(exports)
+        for name in self._exports:
+            if name not in self._namespace:
+                raise MXNetError(
+                    f"PallasModule: export '{name}' not defined by the "
+                    "source")
+
+    def get_kernel(self, name, signature=None):
+        # only declared exports are kernels — without the check an
+        # empty exports list would expose every namespace entry
+        # (imports, ref-kernels, __builtins__) as launchable
+        if name not in self._exports or name not in self._namespace:
+            raise MXNetError(
+                f"PallasModule: no exported kernel '{name}' (declare it "
+                "in exports=[...])")
+        return PallasKernel(self._namespace[name], name)

@@ -10,6 +10,7 @@
 
 #include "mxtpu_c_api.h"
 
+#define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <cstring>
@@ -331,6 +332,125 @@ int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle *out) {
       "grad_of", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
   if (res == nullptr) return -1;
   *out = res;
+  return 0;
+}
+
+// -- predictor (reference: c_predict_api.cc) ----------------------------
+// Predictor handles are PyLong ids into c_api_impl._PREDICTORS, boxed
+// as PyObject* so PredictorHandle stays an opaque pointer.
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 size_t param_size, int /*dev_type*/, int /*dev_id*/,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 PredictorHandle *out) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  PyObject *names = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyObject *s = PyUnicode_FromString(input_keys[i]);
+    if (s == nullptr) {  // e.g. invalid UTF-8 key: error, never crash
+      capture_py_error();
+      Py_DECREF(names);
+      return -1;
+    }
+    PyList_SET_ITEM(names, i, s);
+  }
+  PyObject *res = call_impl(
+      "pred_create",
+      Py_BuildValue("(sy#N)", symbol_json_str,
+                    static_cast<const char *>(param_bytes),
+                    static_cast<Py_ssize_t>(param_size), names));
+  if (res == nullptr) return -1;
+  *out = res;  // PyLong id, owned by the handle
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle h, const char *key, const float *data,
+                   const int64_t *shape, int ndim) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  size_t n = 1;
+  PyObject *shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(shape[i]);
+    PyList_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject *res = call_impl(
+      "pred_set_input",
+      Py_BuildValue("(Osy#N)", static_cast<PyObject *>(h), key,
+                    reinterpret_cast<const char *>(data),
+                    static_cast<Py_ssize_t>(n * sizeof(float)), shp));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle h) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  PyObject *res = call_impl(
+      "pred_forward", Py_BuildValue("(O)", static_cast<PyObject *>(h)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index, int *ndim,
+                         int64_t shape[8]) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  PyObject *res = call_impl(
+      "pred_output_shape",
+      Py_BuildValue("(OI)", static_cast<PyObject *>(h), index));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  if (n > 8) {
+    g_last_error = "MXPredGetOutputShape: ndim > 8";
+    Py_DECREF(res);
+    return -1;
+  }
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(res, i));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float *data,
+                    size_t n_floats) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  PyObject *res = call_impl(
+      "pred_get_output",
+      Py_BuildValue("(OI)", static_cast<PyObject *>(h), index));
+  if (res == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    capture_py_error();
+    Py_DECREF(res);
+    return -1;
+  }
+  if (static_cast<size_t>(len) != n_floats * sizeof(float)) {
+    g_last_error = "MXPredGetOutput: buffer size mismatch (want " +
+                   std::to_string(len) + " bytes)";
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle h) {
+  if (!require_ready()) return -1;
+  Gil gil;
+  PyObject *id = static_cast<PyObject *>(h);
+  PyObject *res = call_impl("pred_free", Py_BuildValue("(O)", id));
+  Py_DECREF(id);
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
   return 0;
 }
 

@@ -63,6 +63,23 @@ int MXAutogradRecordStop(void);
 int MXAutogradBackward(NDArrayHandle loss);
 int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle *out);
 
+/* -- Predictor (reference: include/mxnet/c_predict_api.h) -------------- */
+/* Deploy-format inference: symbol.json text + .params bytes in, float32
+ * tensors in/out.  The amalgamation/mobile predict surface. */
+typedef void *PredictorHandle;
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 size_t param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 PredictorHandle *out);
+int MXPredSetInput(PredictorHandle h, const char *key, const float *data,
+                   const int64_t *shape, int ndim);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index, int *ndim,
+                         int64_t shape[8]);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float *data,
+                    size_t n_floats);
+int MXPredFree(PredictorHandle h);
+
 /* -- KVStore ----------------------------------------------------------- */
 int MXKVStoreCreate(const char *type, KVStoreHandle *out);
 int MXKVStoreInit(KVStoreHandle kv, int key, NDArrayHandle v);

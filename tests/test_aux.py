@@ -242,3 +242,36 @@ def test_storage_memory_knobs_and_info():
     # positive numbers.  Either way the call must not raise.
     assert (free is None) == (total is None)
     assert isinstance(storage.memory_summary(), str)
+
+
+def test_rtc_pallas_module():
+    """mx.rtc parity: PallasModule compiles runtime kernel source
+    (reference: rtc.CudaModule over NVRTC); CudaModule shim guides to
+    the TPU path."""
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    src = """
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def _scale(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+def scale2(x):
+    return pl.pallas_call(
+        _scale, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True)(x)
+"""
+    mod = mx.rtc.PallasModule(src, exports=["scale2"])
+    k = mod.get_kernel("scale2")
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    out = k.launch([x])
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 4.0, 6.0])
+
+    with pytest.raises(mx.base.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    with pytest.raises(mx.base.MXNetError):
+        mx.rtc.PallasModule("x = 1", exports=["missing"])

@@ -267,3 +267,57 @@ def test_cpp_package_linreg_example(capi):
     finally:
         if os.path.exists(binp):
             os.remove(binp)
+
+
+def test_c_predict_api_roundtrip(capi, tmp_path):
+    """MXPred* deploy surface (reference: include/mxnet/c_predict_api.h):
+    export a trained net, run inference through the C predictor only,
+    match the in-process output."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    lib = capi
+    rs = np.random.RandomState(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(rs.randn(2, 5).astype("float32"))
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x).asnumpy()
+    net.export(str(tmp_path / "pred"))
+    sym_json = (tmp_path / "pred-symbol.json").read_text()
+    param_bytes = (tmp_path / "pred-0000.params").read_bytes()
+
+    import ctypes
+
+    lib.MXPredCreate.restype = ctypes.c_int
+    h = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    rc = lib.MXPredCreate(sym_json.encode(), param_bytes,
+                          len(param_bytes), 1, 0, 1, keys,
+                          ctypes.byref(h))
+    assert rc == 0, _err(lib)
+    data = np.ascontiguousarray(x.asnumpy(), np.float32)
+    shape = (ctypes.c_int64 * 2)(2, 5)
+    rc = lib.MXPredSetInput(h, b"data",
+                            data.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)),
+                            shape, 2)
+    assert rc == 0, _err(lib)
+    assert lib.MXPredForward(h) == 0, _err(lib)
+    ndim = ctypes.c_int()
+    oshape = (ctypes.c_int64 * 8)()
+    assert lib.MXPredGetOutputShape(h, 0, ctypes.byref(ndim),
+                                    oshape) == 0, _err(lib)
+    shp = tuple(oshape[i] for i in range(ndim.value))
+    assert shp == (2, 3), shp
+    out = np.empty(shp, np.float32)
+    assert lib.MXPredGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0, _err(lib)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    assert lib.MXPredFree(h) == 0, _err(lib)
