@@ -52,7 +52,8 @@ for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "gluon", "io", "recordio", "image", "profiler", "runtime",
              "parallel", "test_utils", "util", "visualization", "operator",
              "symbol", "model", "module", "lr_scheduler", "distributed",
-             "amp", "checkpoint", "contrib", "rtc", "image_detection"):
+             "amp", "checkpoint", "contrib", "rtc", "image_detection",
+             "subgraph"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -1,0 +1,315 @@
+"""Subgraph partitioning API.
+
+Reference parity: src/operator/subgraph/ (SubgraphProperty,
+MXNET_REGISTER_SUBGRAPH_BACKEND/PROPERTY) + Symbol.optimize_for — the
+mechanism MKLDNN fusion and TensorRT offload plug into: select
+supported nodes, group maximal acyclic regions, hand each region to a
+backend executor.
+
+TPU-first redesign: the flagship backend is "XLA" — a partitioned
+region becomes ONE ``_subgraph_exec`` node whose evaluation
+jit-compiles the whole region (cached on the node), so the legacy
+Symbol/Module path gets whole-region XLA fusion exactly the way
+hybridize() does for gluon.  Custom properties subclass
+SubgraphProperty and register with ``register_subgraph_property``
+(op_filter is the reference's SupportedOps contract).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+_BACKENDS = {}
+
+
+class SubgraphProperty:
+    """Node-selection contract (reference: SubgraphProperty)."""
+
+    #: regions smaller than this stay unpartitioned
+    min_size = 1
+
+    def op_filter(self, op_name, attrs):
+        """True if the op may live inside a partitioned region."""
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__
+
+
+class XLASubgraphProperty(SubgraphProperty):
+    """Everything the registry can trace is XLA-compilable; only opaque
+    host-level ops (mutating optimizer wrappers, IO) stay outside."""
+
+    min_size = 2  # a single op gains nothing from its own jit region
+
+    def op_filter(self, op_name, attrs):
+        try:
+            opdef = _registry.get(op_name)
+        except Exception:
+            return False
+        return not getattr(opdef, "opaque", False)
+
+
+def register_subgraph_property(backend, prop):
+    """Reference: MXNET_REGISTER_SUBGRAPH_PROPERTY."""
+    if not isinstance(prop, SubgraphProperty):
+        raise MXNetError("prop must be a SubgraphProperty instance")
+    _BACKENDS[backend] = prop
+    return prop
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+register_subgraph_property("XLA", XLASubgraphProperty())
+
+_SUBGRAPH_COUNTER = itertools.count()
+
+
+def partition(sym, backend="XLA"):
+    """Group maximal supported regions into ``_subgraph_exec`` nodes
+    (reference: the BuildSubgraph pass behind Symbol.optimize_for).
+
+    Symbol identity is by NAME (out_index views share their node), so
+    the whole pass is name-keyed.  A node joins a producer's group only
+    when that cannot create a cycle through out-of-group nodes
+    (tracked via transitive group-dependency sets).  Regions expose as
+    many outputs as the outside graph consumes (multi-output node).
+    """
+    from . import symbol as _sym_mod
+
+    prop = _BACKENDS.get(backend)
+    if prop is None:
+        raise MXNetError(
+            f"unknown subgraph backend '{backend}' "
+            f"(registered: {list_backends()})")
+
+    # _topo dedups by object id; out_index VIEWS of one node appear as
+    # extra entries sharing the name — the pass is name-keyed, so keep
+    # only the first entry per name
+    topo, _seen_names = [], set()
+    for n in sym._topo():
+        if n.name not in _seen_names:
+            _seen_names.add(n.name)
+            topo.append(n)
+    by_name = {n.name: n for n in topo}
+    supported = {n.name: (n.op is not None
+                          and prop.op_filter(n.op, n.attrs))
+                 for n in topo}
+
+    group_of = {}            # node name -> gid
+    members = {}             # gid -> [node names in topo order]
+    depends_on = {}          # node name -> set of gids upstream of it
+    group_deps = {}          # gid -> set of gids it depends on (direct)
+    gid_counter = itertools.count()
+
+    def _gclosure(gids, acc=None):
+        """Transitive closure over group_deps."""
+        acc = set() if acc is None else acc
+        for g in gids:
+            if g not in acc:
+                acc.add(g)
+                _gclosure(group_deps.get(g, ()), acc)
+        return acc
+
+    def _input_dep_groups(i):
+        """Group-closed set of gids that input entry `i` depends on
+        (including its own group)."""
+        base = set(depends_on.get(i.name, ()))
+        g = group_of.get(i.name)
+        if g is not None:
+            base.add(g)
+        return _gclosure(base)
+
+    for n in topo:
+        node_deps = set()
+        for i in n.inputs:
+            node_deps |= depends_on.get(i.name, set())
+            g = group_of.get(i.name)
+            if g is not None:
+                node_deps.add(g)
+        if not supported[n.name]:
+            depends_on[n.name] = node_deps
+            continue
+        cand = sorted({group_of[i.name] for i in n.inputs
+                       if i.name in group_of})
+        gid = None
+        for g in cand:
+            # joining g is safe iff no input path OUTSIDE g transitively
+            # depends on g (group-closed): such a path would route g's
+            # output around the region and back in — a cycle once each
+            # group becomes one node
+            if all(group_of.get(i.name) == g
+                   or g not in _input_dep_groups(i)
+                   for i in n.inputs):
+                gid = g
+                break
+        if gid is None:
+            gid = next(gid_counter)
+            members[gid] = []
+            group_deps[gid] = set()
+        # the group inherits every dependency the member brings
+        for i in n.inputs:
+            if group_of.get(i.name) != gid:
+                group_deps[gid] |= _input_dep_groups(i)
+        group_deps[gid].discard(gid)
+        group_of[n.name] = gid
+        members[gid].append(n.name)
+        depends_on[n.name] = node_deps - {gid}
+
+    # demote undersized groups
+    for gid, mem in list(members.items()):
+        if len(mem) < prop.min_size:
+            for nm in mem:
+                del group_of[nm]
+            del members[gid]
+
+    # which member outputs (name, out_index) are visible outside?
+    consumers_outside = {gid: [] for gid in members}
+    head_name = topo[-1].name
+    for n in topo:
+        for i in n.inputs:
+            g = group_of.get(i.name)
+            if g is not None and group_of.get(n.name) != g:
+                key = (i.name, i.out_index)
+                if key not in consumers_outside[g]:
+                    consumers_outside[g].append(key)
+    hg = group_of.get(head_name)
+    if hg is not None:
+        key = (head_name, sym.out_index)
+        if key not in consumers_outside[hg]:
+            consumers_outside[hg].append(key)
+
+    # rebuild graph.  rebuilt[name] is either a node-level Symbol or,
+    # for region members, a {out_index: Symbol} map onto the merged
+    # node's outputs.
+    rebuilt = {}
+
+    def lookup(entry):
+        r = rebuilt[entry.name]
+        if isinstance(r, dict):
+            return r[entry.out_index]
+        if entry.out_index:
+            return r[entry.out_index]
+        return r
+
+    last_member = {gid: mem[-1] for gid, mem in members.items()}
+    for n in topo:
+        if n.op is None:
+            v = _sym_mod.var(n.name)
+            v.attrs.update(n.attrs)
+            v._attr_dict.update(n._attr_dict)
+            rebuilt[n.name] = v
+            continue
+        gid = group_of.get(n.name)
+        if gid is None:
+            ins = [lookup(i) for i in n.inputs]
+            rebuilt[n.name] = _sym_mod.apply_op(n.op, *ins,
+                                                name=n.name, **n.attrs)
+            continue
+        if n.name != last_member[gid]:
+            continue  # emitted at the region's last node
+        mem = members[gid]
+        mem_set = set(mem)
+        ext, seen = [], set()
+        for nm in mem:
+            for i in by_name[nm].inputs:
+                key = (i.name, i.out_index)
+                if i.name not in mem_set and key not in seen:
+                    seen.add(key)
+                    ext.append(i)
+        visible = consumers_outside[gid] or [(mem[-1], 0)]
+        node = _sym_mod.Symbol(
+            "_subgraph_exec",
+            f"xla_subgraph{next(_SUBGRAPH_COUNTER)}",
+            [lookup(i) for i in ext],
+            {"__backend__": backend},
+            n_outputs=len(visible))
+        node._attr_dict["__members__"] = [by_name[nm] for nm in mem]
+        node._attr_dict["__ext__"] = [(i.name, i.out_index) for i in ext]
+        node._attr_dict["__visible__"] = list(visible)
+        node._attr_dict["__jit_cache__"] = {}
+        for k, (nm, oi) in enumerate(visible):
+            slot = rebuilt.setdefault(nm, {})
+            if not isinstance(slot, dict):  # shouldn't happen
+                slot = rebuilt[nm] = {}
+            slot[oi] = node[k] if len(visible) > 1 else node
+    head = lookup(_Entry(head_name, sym.out_index))
+    return head
+
+
+class _Entry:
+    __slots__ = ("name", "out_index")
+
+    def __init__(self, name, out_index):
+        self.name = name
+        self.out_index = out_index
+
+
+def subgraph_exec(node, ext_vals):
+    """Evaluate one partitioned region as a single jitted program
+    (called from Symbol._eval_node).
+
+    Execution-scope injection matches _eval_node's contract: random
+    members receive fresh PRNG keys (passed as jit arguments, one per
+    random op per call), and mode-dependent members get _is_training
+    from the autograd scope (one compiled program per mode).
+    """
+    import jax
+
+    from . import autograd as _ag
+    from .random import next_key
+
+    members = node._attr_dict["__members__"]
+    ext = node._attr_dict["__ext__"]
+    visible = node._attr_dict["__visible__"]
+    cache = node._attr_dict["__jit_cache__"]
+
+    random_members = [m.name for m in members
+                      if _registry.get(m.op).random
+                      and m.attrs.get("_key") is None]
+    training = bool(_ag.is_training())
+
+    fn = cache.get(training)
+    if fn is None:
+        def run(vals, keys):
+            env = {}
+            for (nm, oi), v in zip(ext, vals):
+                env.setdefault(nm, {})[oi] = v
+            for m in members:
+                ins = []
+                for i in m.inputs:
+                    slot = env[i.name]
+                    if isinstance(slot, dict):
+                        v = slot.get(i.out_index)
+                    else:
+                        v = slot[i.out_index] \
+                            if isinstance(slot, (tuple, list)) else slot
+                    ins.append(v)
+                opdef = _registry.get(m.op)
+                kwargs = {k: v for k, v in m.attrs.items()
+                          if not k.startswith("__")}
+                if opdef.mode_dependent \
+                        and kwargs.get("_is_training") is None:
+                    kwargs["_is_training"] = training
+                if opdef.random and kwargs.get("_key") is None:
+                    kwargs["_key"] = keys[m.name]
+                out = opdef.fn(*ins, **kwargs)
+                env[m.name] = out if isinstance(out, (tuple, list)) \
+                    else (out,)
+            outs = []
+            for nm, oi in visible:
+                slot = env[nm]
+                outs.append(slot[oi] if isinstance(slot, (tuple, dict))
+                            else slot)
+            return tuple(outs)
+
+        fn = jax.jit(run)
+        cache[training] = fn
+    keys = {nm: next_key() for nm in random_members}
+    out = fn(list(ext_vals), keys)
+    return out if len(visible) > 1 else out[0]

@@ -62,8 +62,13 @@ class Symbol:
         if isinstance(index, int):
             if self._n_outputs == 1 and index == 0:
                 return self
-            return Symbol(self.op, self.name, self.inputs, self.attrs,
+            view = Symbol(self.op, self.name, self.inputs, self.attrs,
                           out_index=index, n_outputs=self._n_outputs)
+            # attrs are NODE-level (eval caches by name): views share
+            # the dict so e.g. a partitioned region's carried state is
+            # reachable through any output view
+            view._attr_dict = self._attr_dict
+            return view
         raise MXNetError("Symbol only supports integer indexing")
 
     # arithmetic via registered broadcast ops
@@ -143,6 +148,13 @@ class Symbol:
             return [f"{self.name}_output"]
         return [f"{self.name}_output{i}" for i in range(self._n_outputs)]
 
+    def optimize_for(self, backend="XLA", **kwargs):
+        """Partition this graph for a subgraph backend (reference:
+        Symbol.optimize_for over src/operator/subgraph/)."""
+        from ..subgraph import partition
+
+        return partition(self, backend)
+
     def get_internals(self):
         return Group([_as_single(n) for n in self._topo()
                       if n.op is not None])
@@ -152,6 +164,15 @@ class Symbol:
         return json.loads(self.tojson())["nodes"]
 
     # -- evaluation ------------------------------------------------------------
+
+    def _eval_inputs(self, node, env, cache):
+        args = []
+        for i in node.inputs:
+            v = self._eval_node(i, env, cache)
+            if isinstance(v, (tuple, list)):
+                v = v[i.out_index]
+            args.append(v)
+        return args
 
     def _eval_node(self, node, env, cache):
         # keyed by node NAME: s and s[1] are distinct Symbol objects viewing
@@ -168,13 +189,13 @@ class Symbol:
                 val = env[node.name]
             else:
                 raise MXNetError(f"unbound variable {node.name}")
+        elif node.op == "_subgraph_exec":
+            # partitioned region (subgraph.py): one jitted program
+            from ..subgraph import subgraph_exec
+
+            val = subgraph_exec(node, self._eval_inputs(node, env, cache))
         else:
-            args = []
-            for i in node.inputs:
-                v = self._eval_node(i, env, cache)
-                if isinstance(v, (tuple, list)):
-                    v = v[i.out_index]
-                args.append(v)
+            args = self._eval_inputs(node, env, cache)
             opdef = _registry.get(node.op)
             kwargs = dict(node.attrs)
             kwargs.pop("__aux__", None)

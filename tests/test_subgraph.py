@@ -1,0 +1,154 @@
+"""Subgraph partitioning (reference: src/operator/subgraph/ +
+tests/python/unittest/test_subgraph_op.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.subgraph as sg
+
+
+def test_partition_whole_graph_single_region():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.broadcast_mul(mx.sym.relu(a + b), a)
+    p = y.optimize_for("XLA")
+    ops = [n.op for n in p._topo() if n.op]
+    assert ops == ["_subgraph_exec"], ops
+    av = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    bv = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(p.eval_raw(a=av, b=bv)),
+                               np.asarray(y.eval_raw(a=av, b=bv)),
+                               rtol=1e-6)
+
+
+def test_partition_multi_output_region_member():
+    """A multi-output op (BatchNorm stats) consumed outside via
+    out_index must surface through the region's outputs."""
+    data = mx.sym.Variable("data")
+    g = mx.sym.Variable("g")
+    be = mx.sym.Variable("be")
+    mm = mx.sym.Variable("mm")
+    mv = mx.sym.Variable("mv")
+    bn = mx.sym.BatchNorm(data, g, be, mm, mv, output_mean_var=True,
+                          fix_gamma=False, _is_training=True)
+    y = mx.sym.broadcast_add(mx.sym.relu(bn[0]),
+                             mx.sym.Reshape(bn[1], shape=(1, -1)))
+    p = y.optimize_for("XLA")
+    dv = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    feed = dict(data=dv, g=np.ones(3, np.float32),
+                be=np.zeros(3, np.float32), mm=np.zeros(3, np.float32),
+                mv=np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(p.eval_raw(**feed)),
+                               np.asarray(y.eval_raw(**feed)),
+                               rtol=1e-6)
+
+
+def test_partition_splits_around_unsupported():
+    class NoRelu(sg.SubgraphProperty):
+        min_size = 1
+
+        def op_filter(self, op, attrs):
+            return op not in ("Activation", "relu") and \
+                sg.XLASubgraphProperty().op_filter(op, attrs)
+
+    sg.register_subgraph_property("_test_norelu", NoRelu())
+    a = mx.sym.Variable("a")
+    y = mx.sym.relu(mx.sym.broadcast_mul(a + a, a))
+    y2 = mx.sym.broadcast_add(mx.sym.relu(y + a), a)
+    p = y2.optimize_for("_test_norelu")
+    ops = [n.op for n in p._topo() if n.op]
+    assert ops.count("relu") == 2
+    assert ops.count("_subgraph_exec") >= 2
+    av = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(p.eval_raw(a=av)),
+                               np.asarray(y2.eval_raw(a=av)), rtol=1e-6)
+
+
+def test_partition_min_size_leaves_small_regions():
+    class Tiny(sg.SubgraphProperty):
+        min_size = 3
+
+        def op_filter(self, op, attrs):
+            return sg.XLASubgraphProperty().op_filter(op, attrs)
+
+    sg.register_subgraph_property("_test_tiny", Tiny())
+    a = mx.sym.Variable("a")
+    y = mx.sym.relu(a)  # 1-op graph < min_size
+    p = y.optimize_for("_test_tiny")
+    ops = [n.op for n in p._topo() if n.op]
+    assert ops == ["relu"], ops
+
+
+def test_unknown_backend_raises():
+    import pytest
+
+    a = mx.sym.Variable("a")
+    with pytest.raises(mx.base.MXNetError, match="backend"):
+        mx.sym.relu(a).optimize_for("no_such_backend")
+
+
+def test_partition_no_group_level_cycle():
+    """Review repro: two groups must not become mutually dependent —
+    X=mul(a,a) [g0], W=relu(X) unsupported, Q=mul(b,b), Y=add(W,Q),
+    M=add(X,Q).  Joining M to g0 while Y's group depends on g0 would
+    deadlock the rebuilt graph."""
+    class NoRelu(sg.SubgraphProperty):
+        min_size = 1
+
+        def op_filter(self, op, attrs):
+            return op not in ("Activation", "relu") and \
+                sg.XLASubgraphProperty().op_filter(op, attrs)
+
+    sg.register_subgraph_property("_test_norelu2", NoRelu())
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    X = mx.sym.broadcast_mul(a, a)
+    W = mx.sym.relu(X)
+    Q = mx.sym.broadcast_mul(b, b)
+    Y = mx.sym.broadcast_add(W, Q)
+    M = mx.sym.broadcast_add(X, Q)
+    out = mx.sym.broadcast_add(Y, M)
+    p = out.optimize_for("_test_norelu2")
+    av = np.random.RandomState(0).randn(2, 2).astype(np.float32)
+    bv = np.random.RandomState(1).randn(2, 2).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(p.eval_raw(a=av, b=bv)),
+                               np.asarray(out.eval_raw(a=av, b=bv)),
+                               rtol=1e-6)
+
+
+def test_partition_random_and_mode_ops_in_region():
+    """Review repro: random (Dropout) members need PRNG keys and mode
+    injection inside the jitted region."""
+    from mxnet_tpu import autograd
+
+    a = mx.sym.Variable("a")
+    y = mx.sym.broadcast_mul(mx.sym.Dropout(a + a, p=0.5), a)
+    p = y.optimize_for("XLA")
+    av = np.ones((4, 64), np.float32)
+    with autograd.train_mode():
+        out_t = np.asarray(p.eval_raw(a=av))
+    # train mode: some elements dropped
+    assert (out_t == 0).any()
+    with autograd.predict_mode():
+        out_p = np.asarray(p.eval_raw(a=av))
+    # predict mode: dropout is identity -> (a+a)*a = 2
+    np.testing.assert_allclose(out_p, 2.0 * np.ones((4, 64)), rtol=1e-6)
+
+
+def test_partition_multioutput_member_not_duplicated():
+    """Review repro: a multi-output node consumed both inside and
+    outside its region must be computed ONCE (inside), its second
+    output surfacing through the region outputs."""
+    data = mx.sym.Variable("data")
+    g = mx.sym.Variable("g")
+    be = mx.sym.Variable("be")
+    mm = mx.sym.Variable("mm")
+    mv = mx.sym.Variable("mv")
+    bn = mx.sym.BatchNorm(data, g, be, mm, mv, output_mean_var=True,
+                          fix_gamma=False, _is_training=True)
+    y = mx.sym.broadcast_add(mx.sym.relu(bn[0]),
+                             mx.sym.Reshape(bn[1], shape=(1, -1)))
+    p = y.optimize_for("XLA")
+    names = [n.name for n in p._topo() if n.op]
+    bn_nodes = [nm for nm in names if "batchnorm" in nm]
+    assert not bn_nodes, f"BatchNorm duplicated outside region: {bn_nodes}"
