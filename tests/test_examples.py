@@ -56,3 +56,9 @@ def test_example_bert_sharded():
                "--steps", "2", "--batch-size", "8", "--seq-len", "32",
                "--dp", "2", "--dtype", "float32")
     assert "loss" in out.lower()
+
+
+def test_example_lstm_language_model():
+    out = _run("lstm_language_model.py", "--epochs", "3", "--tokens",
+               "2000", "--vocab", "50")
+    assert "lstm_language_model OK" in out
