@@ -357,7 +357,11 @@ def bench_bert(cfg, devices):
     n_chips = max(1, len(devices))
     batch_size, seq_len, steps = cfg["batch"], cfg["seq"], cfg["steps"]
 
+    # scan_layers: the 12-layer trunk compiles as ONE scanned layer —
+    # without it the whole-step AOT compile through the tunnel takes
+    # tens of minutes and blows the worker budget
     net = bert_zoo.bert_base(dropout=0.0, max_length=seq_len,
+                             scan_layers=True,
                              attention_impl="flash"
                              if devices[0].platform != "cpu" else "dense")
     net.initialize(init=mx.init.Xavier())

@@ -75,6 +75,11 @@ def main():
 
     data = batchify(synthetic_corpus(args.vocab, args.tokens),
                     args.batch_size)
+    if data.shape[0] <= args.bptt + 1:
+        sys.exit(f"corpus too small: {data.shape[0]} rows after "
+                 f"batchify(batch_size={args.batch_size}) but bptt="
+                 f"{args.bptt} needs > bptt+1; add --tokens or shrink "
+                 "--batch-size/--bptt")
     model = RNNModel(args.vocab, args.embed, args.hidden, args.layers,
                      dropout=0.2)
     model.initialize(init=mx.init.Xavier())

@@ -100,6 +100,81 @@ class TransformerEncoder(HybridBlock):
         return self.ln_f(x)
 
 
+class ScanTransformerEncoder(HybridBlock):
+    """Encoder trunk as ONE ``lax.scan`` over stacked per-layer params.
+
+    TPU-first compile-time scalability: N separate layer blocks emit an
+    HLO that grows linearly with depth (BERT-base whole-step compiles
+    took tens of minutes through the AOT helper); scanning a single
+    layer body over (L, ...) parameter stacks compiles the layer once.
+    Numerics match TransformerEncoder exactly (same pre-LN math, same
+    packed-qkv MHA op) — equivalence-tested in tests/test_model_zoo.py.
+
+    Stacked params use ``*_stack_*`` names so TP rules shard dim 1+
+    (the layer dim stays unsharded); see TRANSFORMER_TP_RULES.
+    """
+
+    def __init__(self, num_layers, units, num_heads, hidden_size=None,
+                 dropout=0.1, attention_impl="dense",
+                 activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        hidden_size = hidden_size or 4 * units
+        self._num_layers = num_layers
+        self._units = units
+        self._num_heads = num_heads
+        self._hidden = hidden_size
+        self._dropout = dropout
+        self._attention_impl = attention_impl
+        self._activation = activation
+        L, u, h = num_layers, units, hidden_size
+        with self.name_scope():
+            self.qkv_stack_weight = self.params.get(
+                "qkv_stack_weight", shape=(L, 3 * u, u))
+            self.qkv_stack_bias = self.params.get(
+                "qkv_stack_bias", shape=(L, 3 * u), init="zeros")
+            self.proj_stack_weight = self.params.get(
+                "proj_stack_weight", shape=(L, u, u))
+            self.proj_stack_bias = self.params.get(
+                "proj_stack_bias", shape=(L, u), init="zeros")
+            self.ffn1_stack_weight = self.params.get(
+                "ffn1_stack_weight", shape=(L, h, u))
+            self.ffn1_stack_bias = self.params.get(
+                "ffn1_stack_bias", shape=(L, h), init="zeros")
+            self.ffn2_stack_weight = self.params.get(
+                "ffn2_stack_weight", shape=(L, u, h))
+            self.ffn2_stack_bias = self.params.get(
+                "ffn2_stack_bias", shape=(L, u), init="zeros")
+            self.ln1_stack_gamma = self.params.get(
+                "ln1_stack_gamma", shape=(L, u), init="ones")
+            self.ln1_stack_beta = self.params.get(
+                "ln1_stack_beta", shape=(L, u), init="zeros")
+            self.ln2_stack_gamma = self.params.get(
+                "ln2_stack_gamma", shape=(L, u), init="ones")
+            self.ln2_stack_beta = self.params.get(
+                "ln2_stack_beta", shape=(L, u), init="zeros")
+            self.lnf_gamma = self.params.get("lnf_gamma", shape=(u,),
+                                             init="ones")
+            self.lnf_beta = self.params.get("lnf_beta", shape=(u,),
+                                            init="zeros")
+
+    def hybrid_forward(self, F, x, qkv_stack_weight, qkv_stack_bias,
+                       proj_stack_weight, proj_stack_bias,
+                       ffn1_stack_weight, ffn1_stack_bias,
+                       ffn2_stack_weight, ffn2_stack_bias,
+                       ln1_stack_gamma, ln1_stack_beta,
+                       ln2_stack_gamma, ln2_stack_beta,
+                       lnf_gamma, lnf_beta):
+        return F.scan_transformer_encoder(
+            x, qkv_stack_weight, qkv_stack_bias, proj_stack_weight,
+            proj_stack_bias, ffn1_stack_weight, ffn1_stack_bias,
+            ffn2_stack_weight, ffn2_stack_bias, ln1_stack_gamma,
+            ln1_stack_beta, ln2_stack_gamma, ln2_stack_beta,
+            lnf_gamma, lnf_beta, num_heads=self._num_heads,
+            dropout=self._dropout, activation=self._activation,
+            impl=self._attention_impl)
+
+
 class BERTModel(HybridBlock):
     """BERT encoder with MLM + NSP heads (BASELINE: tokens/sec/chip
     pretrain config)."""
@@ -108,7 +183,7 @@ class BERTModel(HybridBlock):
                  num_heads=12, hidden_size=3072, max_length=512,
                  token_types=2, dropout=0.1, attention_impl="dense",
                  use_pooler=True, use_decoder=True, use_classifier=True,
-                 **kwargs):
+                 scan_layers=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._use_pooler = use_pooler
@@ -128,9 +203,14 @@ class BERTModel(HybridBlock):
             if dropout:
                 self.embed_drop = nn.Dropout(dropout)
             self._dropout = dropout
-            self.encoder = TransformerEncoder(
-                num_layers, units, num_heads, hidden_size, dropout,
-                attention_impl, prefix="enc_")
+            if scan_layers:
+                self.encoder = ScanTransformerEncoder(
+                    num_layers, units, num_heads, hidden_size, dropout,
+                    attention_impl, prefix="enc_")
+            else:
+                self.encoder = TransformerEncoder(
+                    num_layers, units, num_heads, hidden_size, dropout,
+                    attention_impl, prefix="enc_")
             if use_pooler:
                 self.pooler = nn.Dense(units, activation="tanh",
                                        in_units=units, prefix="pooler_")

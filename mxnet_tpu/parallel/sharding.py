@@ -51,6 +51,14 @@ TRANSFORMER_TP_RULES = ShardingRules(rules=[
     (r"ffn1_bias$", (TP,)),
     (r"ffn2_weight$", (None, TP)),
     (r"word_embed_weight$|embedding\d*_weight$", (TP, None)),
+    # scanned trunk (ScanTransformerEncoder): (L, ...) stacks — layer
+    # dim unsharded, same Megatron column/row split on dims 1+
+    (r"qkv_stack_weight$", (None, TP, None)),
+    (r"qkv_stack_bias$", (None, TP)),
+    (r"proj_stack_weight$", (None, None, TP)),
+    (r"ffn1_stack_weight$", (None, TP, None)),
+    (r"ffn1_stack_bias$", (None, TP)),
+    (r"ffn2_stack_weight$", (None, None, TP)),
 ], default=())
 
 # expert parallelism: MoE expert weights shard on their leading E axis

@@ -88,3 +88,84 @@ def test_model_zoo_save_load(tmp_path):
     net2.load_parameters(f)
     np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_scan_transformer_encoder_matches_unstacked():
+    """ScanTransformerEncoder (lax.scan trunk) must equal
+    TransformerEncoder layer-by-layer math, fwd and grads."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon.model_zoo import bert as bz
+
+    rs = np.random.RandomState(0)
+    L, U, H = 3, 32, 4
+    enc = bz.TransformerEncoder(L, U, H, dropout=0.0)
+    enc.initialize(init=mx.init.Xavier())
+    senc = bz.ScanTransformerEncoder(L, U, H, dropout=0.0)
+    senc.initialize(init=mx.init.Xavier())
+
+    ep = enc.collect_params()
+    epre = [n for n in ep if n.endswith("layer0_qkv_weight")][0]
+    eprefix = epre[:-len("layer0_qkv_weight")]
+    sp = senc.collect_params()
+    spre = [n for n in sp if n.endswith("qkv_stack_weight")][0]
+    sprefix = spre[:-len("qkv_stack_weight")]
+
+    def stack(name):
+        return nd.array(np.stack(
+            [ep[f"{eprefix}layer{i}_{name}"].data().asnumpy()
+             for i in range(L)]))
+
+    for nm in ("qkv_weight", "qkv_bias", "proj_weight", "proj_bias",
+               "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"):
+        sp[f"{sprefix}{nm.replace('_', '_stack_', 1)}"].set_data(
+            stack(nm))
+    for li, tag in ((0, "ln1"), (1, "ln2")):
+        for wb in ("gamma", "beta"):
+            sp[f"{sprefix}{tag}_stack_{wb}"].set_data(nd.array(np.stack(
+                [ep[f"{eprefix}layer{i}_layernorm{li}_{wb}"]
+                 .data().asnumpy() for i in range(L)])))
+    for wb in ("gamma", "beta"):
+        # final LN sits directly under the encoder prefix (no layer{i}_)
+        final = [n for n in ep
+                 if n.startswith(f"{eprefix}layernorm")
+                 and n.endswith(wb)]
+        sp[f"{sprefix}lnf_{wb}"].set_data(ep[final[0]].data())
+
+    x = nd.array(rs.randn(2, 5, U).astype("float32"))
+    x2 = nd.array(x.asnumpy())
+    x.attach_grad()
+    x2.attach_grad()
+    with autograd.record():
+        y1 = enc(x)
+        (y1 * y1).sum().backward()
+    with autograd.record():
+        y2 = senc(x2)
+        (y2 * y2).sum().backward()
+    np.testing.assert_allclose(y2.asnumpy(), y1.asnumpy(), atol=2e-5)
+    np.testing.assert_allclose(x2.grad.asnumpy(), x.grad.asnumpy(),
+                               atol=2e-4)
+
+
+def test_bert_scan_layers_trains():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import bert as bz
+
+    net = bz.bert_tiny(dropout=0.0, scan_layers=True, max_length=32)
+    net.initialize(init=mx.init.Xavier())
+    tr = parallel.ShardedTrainer(
+        net, bz.BERTPretrainLoss(), "adamw", {"learning_rate": 1e-3},
+        mesh=parallel.data_parallel_mesh(1))
+    rs = np.random.RandomState(0)
+    ids = mx.nd.array(rs.randint(0, 512, (4, 32)).astype("int32"))
+    mlm = np.where(rs.rand(4, 32) < 0.2,
+                   rs.randint(0, 512, (4, 32)), -1).astype("int32")
+    nsp = rs.randint(0, 2, (4,)).astype("int32")
+    losses = [float(np.asarray(
+        tr.step(ids, (mx.nd.array(mlm), mx.nd.array(nsp)))._data,
+        dtype=np.float32)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

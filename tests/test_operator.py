@@ -495,6 +495,9 @@ SKIP = {
     "Embedding_like": "alias surface",
     "MoEFFN_op": "MoE dispatch/combine covered vs oracle + ep-sharded "
                  "step in tests/test_parallel.py (moe suite)",
+    "scan_transformer_encoder":
+        "lax.scan trunk equivalence-tested (fwd+grads) vs the "
+        "unstacked TransformerEncoder in tests/test_model_zoo.py",
 }
 
 

@@ -653,3 +653,44 @@ def test_moe_expert_parallel_step_matches_single_device():
         losses[name] = ls
     np.testing.assert_allclose(losses["single"], losses["dp2ep4"],
                                rtol=2e-4)
+
+
+def test_scan_bert_tensor_parallel_sharding():
+    """Review regression: scan_layers=True stacks must shard under the
+    TP rules (layer dim unsharded, Megatron split on dims 1+), and a
+    dp×tp step must run and match dp-only losses."""
+    import jax
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import bert as bz
+
+    mesh = parallel.make_mesh(dp=2, tp=4)
+    rules = parallel.TRANSFORMER_TP_RULES
+    from jax.sharding import PartitionSpec as P
+
+    assert tuple(rules.spec_for("enc_qkv_stack_weight")) == \
+        (None, "tp", None)
+    assert tuple(rules.spec_for("enc_proj_stack_weight")) == \
+        (None, None, "tp")
+    assert tuple(rules.spec_for("enc_ffn2_stack_weight")) == \
+        (None, None, "tp")
+
+    def run(mesh, rules):
+        mx.random.seed(3)
+        net = bz.bert_tiny(dropout=0.0, scan_layers=True, max_length=16)
+        net.initialize(init=mx.init.Xavier())
+        tr = parallel.ShardedTrainer(
+            net, bz.BERTPretrainLoss(), "adamw",
+            {"learning_rate": 1e-3}, mesh=mesh, rules=rules)
+        rs = np.random.RandomState(0)
+        ids = mx.nd.array(rs.randint(0, 512, (8, 16)).astype("int32"))
+        mlm = np.where(rs.rand(8, 16) < 0.2,
+                       rs.randint(0, 512, (8, 16)), -1).astype("int32")
+        nsp = rs.randint(0, 2, (8,)).astype("int32")
+        return [float(np.asarray(
+            tr.step(ids, (mx.nd.array(mlm), mx.nd.array(nsp)))._data,
+            dtype=np.float32)) for _ in range(2)]
+
+    l_tp = run(mesh, rules)
+    l_dp = run(parallel.make_mesh(dp=2), None)
+    np.testing.assert_allclose(l_tp, l_dp, rtol=2e-4)

@@ -56,6 +56,7 @@ def build_bert(args):
     from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
 
     net = bert_zoo.bert_base(dropout=0.0, max_length=args.seq,
+                             scan_layers=not args.no_scan,
                              attention_impl=args.attn)
     net.initialize(init=mx.init.Xavier())
     net.cast("bfloat16")
@@ -84,6 +85,8 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--attn", default="flash")
+    ap.add_argument("--no-scan", action="store_true",
+                    help="unstacked per-layer blocks (slow compile)")
     ap.add_argument("--xplane", default=None,
                     help="directory to dump a jax.profiler trace into")
     ap.add_argument("--hlo-out", default=None,
