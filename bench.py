@@ -76,14 +76,19 @@ def _bert_attempts():
     if os.environ.get("BENCH_SKIP_TPU"):
         return [({"JAX_PLATFORMS": "cpu"},
                  {"model": "bert", "batch": 2, "seq": 128, "steps": 2,
-                  "backend": "cpu"}, 240)]
+                  "backend": "cpu", "attn": "dense"}, 240)]
     return [
         (None, {"model": "bert",
                 "batch": int(os.environ.get("BENCH_BERT_BATCH", 32)),
                 "seq": int(os.environ.get("BENCH_BERT_SEQ", 512)),
-                "steps": steps, "backend": "tpu"}, budget),
+                "steps": steps, "backend": "tpu", "attn": "flash"},
+         budget),
         (None, {"model": "bert", "batch": 8, "seq": 512, "steps": 6,
-                "backend": "tpu"}, min(300, budget)),
+                "backend": "tpu", "attn": "flash"}, min(300, budget)),
+        # dense-attention fallback: a Pallas/Mosaic compile failure must
+        # not cost the whole metric
+        (None, {"model": "bert", "batch": 16, "seq": 512, "steps": 6,
+                "backend": "tpu", "attn": "dense"}, min(420, budget)),
     ]
 
 
@@ -362,8 +367,7 @@ def bench_bert(cfg, devices):
     # tens of minutes and blows the worker budget
     net = bert_zoo.bert_base(dropout=0.0, max_length=seq_len,
                              scan_layers=True,
-                             attention_impl="flash"
-                             if devices[0].platform != "cpu" else "dense")
+                             attention_impl=cfg.get("attn", "dense"))
     net.initialize(init=mx.init.Xavier())
     net.cast("bfloat16")
 
