@@ -47,20 +47,30 @@ class GradientCompression:
 
     # -- quantization (local, with error feedback) -----------------------------
 
+    def _accumulate(self, key, grad):
+        r = self._residual.get(key)
+        return grad if r is None else grad + r
+
+    def _threshold_quantize(self, acc, dtype):
+        """(pos_mask, neg_mask, q) for the 2-bit threshold rule."""
+        import jax.numpy as jnp
+
+        t = jnp.asarray(self.threshold, dtype)
+        pos = acc >= t
+        neg = acc <= -t
+        q = jnp.where(pos, t, jnp.where(neg, -t, jnp.zeros((), dtype)))
+        return pos, neg, q
+
     def quantize(self, key, grad):
         """Return the dequantized-on-this-worker gradient contribution and
         update the residual.  ``grad`` is a raw jax array."""
         import jax.numpy as jnp
 
-        r = self._residual.get(key)
-        acc = grad if r is None else grad + r
+        acc = self._accumulate(key, grad)
         if self.type == "fp16":
             q = acc.astype(jnp.float16).astype(grad.dtype)
         else:
-            t = jnp.asarray(self.threshold, grad.dtype)
-            q = jnp.where(acc >= t, t,
-                          jnp.where(acc <= -t, -t,
-                                    jnp.zeros((), grad.dtype)))
+            _, _, q = self._threshold_quantize(acc, grad.dtype)
         self._residual[key] = acc - q
         return q
 
@@ -70,13 +80,8 @@ class GradientCompression:
         import jax.numpy as jnp
 
         assert self.type == "2bit"
-        r = self._residual.get(key)
-        acc = grad if r is None else grad + r
-        t = jnp.asarray(self.threshold, grad.dtype)
-        pos = acc >= t
-        neg = acc <= -t
-        q = jnp.where(pos, t, jnp.where(neg, -t,
-                                        jnp.zeros((), grad.dtype)))
+        acc = self._accumulate(key, grad)
+        pos, neg, q = self._threshold_quantize(acc, grad.dtype)
         self._residual[key] = acc - q
         c = jnp.where(pos, jnp.uint8(1),
                       jnp.where(neg, jnp.uint8(2), jnp.uint8(0)))

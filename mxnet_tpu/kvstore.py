@@ -39,6 +39,21 @@ def _device_fingerprint():
     return tuple(sorted((d.process_index, d.id) for d in jax.devices()))
 
 
+def _per_process_mesh():
+    """One device per process: the DCN axis both eager collectives run
+    over."""
+    import numpy as _np
+
+    import jax
+    from jax.sharding import Mesh
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[p] for p in sorted(per_proc)]
+    return Mesh(_np.asarray(devs), ("w",))
+
+
 def _cross_process_allreduce(raw):
     """Eager cross-process all-reduce: each process contributes its local
     value; the summed result comes back replicated.
@@ -59,12 +74,7 @@ def _cross_process_allreduce(raw):
     key = (tuple(raw.shape), str(raw.dtype), _device_fingerprint())
     entry = _ALLREDUCE_CACHE.get(key)
     if entry is None:
-        # one device per process: the DCN axis
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        devs = [per_proc[p] for p in sorted(per_proc)]
-        mesh = Mesh(_np.asarray(devs), ("w",))
+        mesh = _per_process_mesh()
         in_s = NamedSharding(mesh, PartitionSpec("w"))
         out_s = NamedSharding(mesh, PartitionSpec())
         fn = jax.jit(lambda x: x.sum(axis=0), in_shardings=in_s,
@@ -97,11 +107,7 @@ def _cross_process_compressed_allreduce(packed, n, threshold, dtype):
            _device_fingerprint())
     entry = _ALLREDUCE_CACHE.get(key)
     if entry is None:
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        devs = [per_proc[p] for p in sorted(per_proc)]
-        mesh = Mesh(_np.asarray(devs), ("w",))
+        mesh = _per_process_mesh()
         in_s = NamedSharding(mesh, PartitionSpec("w"))
         out_s = NamedSharding(mesh, PartitionSpec())
 
