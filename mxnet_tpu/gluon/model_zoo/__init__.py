@@ -8,3 +8,4 @@ from .bert import (BERTModel, BERTPretrainLoss, TransformerEncoder,
                    TransformerEncoderLayer, bert_base, bert_large,
                    bert_tiny)
 from .model_store import get_model_file, purge
+from . import transformer

@@ -1,0 +1,233 @@
+"""Encoder-decoder Transformer (NMT family).
+
+Reference context: the reference repo ships transformer kernels
+(src/operator/contrib/transformer.cu) and the seq2seq models live in
+external packages (sockeye/gluon-nlp, the BASELINE "NMT at long seq"
+config — SURVEY §5.7).  Provided natively, TPU-first: packed-QKV
+self-attention (causal in the decoder), cross-attention over encoder
+memory, pre-LN everywhere, label-smoothed loss, and greedy decode via
+a python loop (host-driven; each step is a jitted forward under
+hybridize).
+
+Sequence parallelism: attention impl is selectable ('dense', 'flash',
+'ring') exactly as in the BERT family.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn
+from .bert import TransformerEncoder
+
+
+class TransformerDecoderLayer(HybridBlock):
+    """Pre-LN decoder layer: causal self-attn → cross-attn → FFN."""
+
+    def __init__(self, units, num_heads, hidden_size=None, dropout=0.1,
+                 attention_impl="dense", activation="relu", **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        hidden_size = hidden_size or 4 * units
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self._attention_impl = attention_impl
+        self._activation = activation
+        with self.name_scope():
+            self.self_qkv_weight = self.params.get(
+                "self_qkv_weight", shape=(3 * units, units))
+            self.self_qkv_bias = self.params.get(
+                "self_qkv_bias", shape=(3 * units,), init="zeros")
+            self.self_proj_weight = self.params.get(
+                "self_proj_weight", shape=(units, units))
+            self.self_proj_bias = self.params.get(
+                "self_proj_bias", shape=(units,), init="zeros")
+            self.cross_qkv_weight = self.params.get(
+                "cross_qkv_weight", shape=(3 * units, units))
+            self.cross_qkv_bias = self.params.get(
+                "cross_qkv_bias", shape=(3 * units,), init="zeros")
+            self.cross_proj_weight = self.params.get(
+                "cross_proj_weight", shape=(units, units))
+            self.cross_proj_bias = self.params.get(
+                "cross_proj_bias", shape=(units,), init="zeros")
+            self.ffn1_weight = self.params.get(
+                "ffn1_weight", shape=(hidden_size, units))
+            self.ffn1_bias = self.params.get(
+                "ffn1_bias", shape=(hidden_size,), init="zeros")
+            self.ffn2_weight = self.params.get(
+                "ffn2_weight", shape=(units, hidden_size))
+            self.ffn2_bias = self.params.get(
+                "ffn2_bias", shape=(units,), init="zeros")
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ln3 = nn.LayerNorm(in_channels=units)
+            if dropout:
+                self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, memory, self_qkv_weight,
+                       self_qkv_bias, self_proj_weight, self_proj_bias,
+                       cross_qkv_weight, cross_qkv_bias,
+                       cross_proj_weight, cross_proj_bias, ffn1_weight,
+                       ffn1_bias, ffn2_weight, ffn2_bias):
+        h = self.ln1(x)
+        attn = F.multi_head_attention(
+            h, h, h, qkv_weight=self_qkv_weight,
+            qkv_bias=self_qkv_bias, proj_weight=self_proj_weight,
+            proj_bias=self_proj_bias, num_heads=self._num_heads,
+            causal=True, impl=self._attention_impl)
+        if self._dropout:
+            attn = self.drop(attn)
+        x = x + attn
+        h = self.ln2(x)
+        cross = F.multi_head_attention(
+            h, memory, memory, qkv_weight=cross_qkv_weight,
+            qkv_bias=cross_qkv_bias, proj_weight=cross_proj_weight,
+            proj_bias=cross_proj_bias, num_heads=self._num_heads,
+            impl="dense")
+        if self._dropout:
+            cross = self.drop(cross)
+        x = x + cross
+        h = self.ln3(x)
+        h = F.FullyConnected(h, ffn1_weight, ffn1_bias,
+                             num_hidden=ffn1_weight.shape[0],
+                             flatten=False)
+        h = F.Activation(h, act_type=self._activation)
+        h = F.FullyConnected(h, ffn2_weight, ffn2_bias,
+                             num_hidden=ffn2_weight.shape[0],
+                             flatten=False)
+        if self._dropout:
+            h = self.drop(h)
+        return x + h
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, num_heads, hidden_size=None,
+                 dropout=0.1, attention_impl="dense", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = []
+            for i in range(num_layers):
+                layer = TransformerDecoderLayer(
+                    units, num_heads, hidden_size, dropout,
+                    attention_impl, prefix=f"layer{i}_")
+                self.register_child(layer)
+                self.layers.append(layer)
+            self.ln_f = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, memory):
+        for layer in self.layers:
+            x = layer(x, memory)
+        return self.ln_f(x)
+
+
+class Transformer(HybridBlock):
+    """Full encoder-decoder Transformer for seq2seq (NMT)."""
+
+    def __init__(self, src_vocab, tgt_vocab, units=512, num_layers=6,
+                 num_heads=8, hidden_size=None, max_length=512,
+                 dropout=0.1, attention_impl="dense", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.src_embed_weight = self.params.get(
+                "src_embed_weight", shape=(src_vocab, units),
+                init="normal")
+            self.tgt_embed_weight = self.params.get(
+                "tgt_embed_weight", shape=(tgt_vocab, units),
+                init="normal")
+            self.position_embed_weight = self.params.get(
+                "position_embed_weight", shape=(max_length, units),
+                init="normal")
+            self.encoder = TransformerEncoder(
+                num_layers, units, num_heads, hidden_size, dropout,
+                attention_impl, prefix="enc_")
+            self.decoder = TransformerDecoder(
+                num_layers, units, num_heads, hidden_size, dropout,
+                attention_impl, prefix="dec_")
+            self.out_proj = nn.Dense(tgt_vocab, in_units=units,
+                                     flatten=False, prefix="out_")
+
+    def hybrid_forward(self, F, src_tokens, tgt_tokens,
+                       src_embed_weight, tgt_embed_weight,
+                       position_embed_weight):
+        scale = float(self._units) ** 0.5
+        src = F.Embedding(src_tokens, src_embed_weight,
+                          input_dim=src_embed_weight.shape[0],
+                          output_dim=src_embed_weight.shape[1]) * scale
+        tgt = F.Embedding(tgt_tokens, tgt_embed_weight,
+                          input_dim=tgt_embed_weight.shape[0],
+                          output_dim=tgt_embed_weight.shape[1]) * scale
+        src = src + F.slice(position_embed_weight,
+                            begin=(0, 0),
+                            end=(src.shape[-2], None))
+        tgt = tgt + F.slice(position_embed_weight,
+                            begin=(0, 0),
+                            end=(tgt.shape[-2], None))
+        memory = self.encoder(src)
+        dec = self.decoder(tgt, memory)
+        return self.out_proj(dec)
+
+    def greedy_decode(self, src_tokens, bos_id, eos_id, max_len=64):
+        """Host-driven greedy decoding (reference analog: sockeye's
+        inference loop)."""
+        import numpy as np
+
+        from ... import ndarray as nd
+
+        B = src_tokens.shape[0]
+        tgt = np.full((B, 1), bos_id, np.int32)
+        finished = np.zeros(B, bool)
+        for _ in range(max_len - 1):
+            logits = self(src_tokens, nd.array(tgt.astype("float32")))
+            nxt = logits.asnumpy()[:, -1].argmax(axis=-1).astype(np.int32)
+            nxt = np.where(finished, eos_id, nxt)
+            tgt = np.concatenate([tgt, nxt[:, None]], axis=1)
+            finished |= nxt == eos_id
+            if finished.all():
+                break
+        return tgt
+
+
+class LabelSmoothedCELoss(HybridBlock):
+    """Label-smoothed cross entropy (the NMT training loss; reference
+    analog: sockeye/gluon-nlp label smoothing)."""
+
+    def __init__(self, smoothing=0.1, ignore_index=-1, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = smoothing
+        self._ignore = ignore_index
+
+    def hybrid_forward(self, F, logits, labels):
+        from ...ndarray.register import invoke_simple
+
+        eps, ignore = self._eps, self._ignore
+
+        def pure(logits, labels):
+            import jax
+            import jax.numpy as jnp
+
+            labels = labels.astype(jnp.int32)
+            V = logits.shape[-1]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+            valid = labels != ignore
+            safe = jnp.maximum(labels, 0)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+            smooth = -jnp.mean(logp, axis=-1)
+            loss = (1.0 - eps) * nll + eps * smooth
+            denom = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(jnp.where(valid, loss, 0.0)) / denom
+
+        return invoke_simple(pure, (logits, labels))
+
+
+def transformer_base(src_vocab, tgt_vocab, **kwargs):
+    """'base' config of the original paper."""
+    return Transformer(src_vocab, tgt_vocab, units=512, num_layers=6,
+                       num_heads=8, hidden_size=2048, **kwargs)
+
+
+def transformer_tiny(src_vocab, tgt_vocab, **kwargs):
+    return Transformer(src_vocab, tgt_vocab, units=32, num_layers=2,
+                       num_heads=2, hidden_size=64, **kwargs)
