@@ -4,5 +4,6 @@ from .symbol import (Group, Symbol, Variable, apply_op, fromjson, load,
                      trace_block, var)
 from .executor import Executor
 from . import register as _register
+from . import contrib
 
 _register.populate(globals())

@@ -226,3 +226,17 @@ def test_exported_json_scalar_positional_roundtrip(tmp_path):
     sb = gluon.SymbolBlock.imports(str(tmp_path / "r6-symbol.json"),
                                    ["data"])
     np.testing.assert_allclose(sb(x).asnumpy(), [[0.0, 3.0, 6.0]])
+
+
+def test_symbol_contrib_namespace():
+    """mx.sym.contrib mirrors the contrib op surface as graph builders
+    (reference: python/mxnet/symbol/contrib.py)."""
+    assert hasattr(mx.sym.contrib, "box_nms")
+    d = mx.sym.Variable("dets")
+    out = mx.sym.contrib.box_nms(d, overlap_thresh=0.5,
+                                 valid_thresh=0.01)
+    dets = np.array([[[0.9, 0.1, 0.1, 0.5, 0.5],
+                      [0.8, 0.12, 0.12, 0.52, 0.52],
+                      [0.7, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    res = np.asarray(out.eval_raw(dets=dets))
+    assert res.shape == (1, 3, 5)
