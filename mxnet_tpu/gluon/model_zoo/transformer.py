@@ -231,3 +231,72 @@ def transformer_base(src_vocab, tgt_vocab, **kwargs):
 def transformer_tiny(src_vocab, tgt_vocab, **kwargs):
     return Transformer(src_vocab, tgt_vocab, units=32, num_layers=2,
                        num_heads=2, hidden_size=64, **kwargs)
+
+
+def beam_search(model, src_tokens, bos_id, eos_id, beam_size=4,
+                max_len=64, alpha=0.6):
+    """Length-normalized beam search (reference analog: sockeye's
+    inference; length penalty ((5+|Y|)/6)^alpha from GNMT).
+
+    Host-driven loop; each scoring step is one batched forward over
+    B*beam hypotheses.  Returns (tokens (B, <=max_len), scores (B,)).
+    """
+    import numpy as np
+
+    from ... import autograd
+    from ... import ndarray as nd
+
+    B = src_tokens.shape[0]
+    K = beam_size
+    src_np = src_tokens.asnumpy() if hasattr(src_tokens, "asnumpy") \
+        else np.asarray(src_tokens)
+    # tile sources per beam: (B*K, S)
+    src_rep = nd.array(np.repeat(src_np, K, axis=0))
+
+    beams = np.full((B, K, 1), bos_id, np.int32)
+    scores = np.full((B, K), -1e9, np.float32)
+    scores[:, 0] = 0.0  # only the first beam is live initially
+    finished = np.zeros((B, K), bool)
+
+    for _ in range(max_len - 1):
+        flat = beams.reshape(B * K, -1)
+        with autograd.predict_mode():
+            logits = model(src_rep, nd.array(flat.astype("float32")))
+        logp = logits.asnumpy()[:, -1]
+        logp = logp - _logsumexp(logp)  # normalize to log-probs
+        V = logp.shape[-1]
+        logp = logp.reshape(B, K, V)
+        # finished beams only extend with EOS at no cost
+        logp_ext = np.where(
+            finished[:, :, None],
+            np.where(np.arange(V)[None, None, :] == eos_id, 0.0, -1e9),
+            logp)
+        total = scores[:, :, None] + logp_ext           # (B, K, V)
+        flat_total = total.reshape(B, K * V)
+        top = np.argsort(-flat_total, axis=1)[:, :K]     # (B, K)
+        new_scores = np.take_along_axis(flat_total, top, axis=1)
+        src_beam = top // V
+        tok = (top % V).astype(np.int32)
+        beams = np.concatenate(
+            [np.take_along_axis(beams, src_beam[:, :, None], axis=1),
+             tok[:, :, None]], axis=2)
+        finished = np.take_along_axis(finished, src_beam, axis=1) \
+            | (tok == eos_id)
+        scores = new_scores
+        if finished.all():
+            break
+
+    # GNMT length penalty on the FINAL scores
+    lengths = (beams != eos_id).sum(axis=2).astype(np.float32)
+    lp = ((5.0 + lengths) / 6.0) ** alpha
+    normed = scores / lp
+    best = normed.argmax(axis=1)
+    out = beams[np.arange(B), best]
+    return out, normed[np.arange(B), best]
+
+
+def _logsumexp(a):
+    import numpy as np
+
+    m = a.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(a - m).sum(axis=-1, keepdims=True))

@@ -222,3 +222,32 @@ def test_transformer_decoder_is_causal():
         l2 = net(src, nd.array(tgt2)).asnumpy()
     np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], atol=1e-5)
     assert np.abs(l1[:, -1] - l2[:, -1]).max() > 1e-4
+
+
+def test_transformer_beam_search_beats_or_matches_greedy():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.model_zoo import transformer as tfm
+
+    rs = np.random.RandomState(0)
+    V, B, T = 20, 8, 6
+    net = tfm.transformer_tiny(V, V, dropout=0.0, max_length=16)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    loss_fn = tfm.LabelSmoothedCELoss(smoothing=0.0)
+    src_np = rs.randint(3, V, (B, T)).astype("float32")
+    tgt_in = np.concatenate([np.full((B, 1), 1.0),
+                             src_np[:, :-1]], axis=1)
+    src = nd.array(src_np)
+    for _ in range(120):
+        with autograd.record():
+            loss = loss_fn(net(src, nd.array(tgt_in)), nd.array(src_np))
+        loss.backward()
+        trainer.step(B)
+    out, sc = tfm.beam_search(net, src, bos_id=1, eos_id=2, beam_size=3,
+                              max_len=T + 1)
+    acc = (out[:, 1:T + 1] == src_np.astype(np.int32)).mean()
+    assert acc > 0.9, acc
+    assert np.isfinite(sc).all()
