@@ -116,10 +116,11 @@ class ScanTransformerEncoder(HybridBlock):
 
     def __init__(self, num_layers, units, num_heads, hidden_size=None,
                  dropout=0.1, attention_impl="dense",
-                 activation="gelu", **kwargs):
+                 activation="gelu", remat=False, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         hidden_size = hidden_size or 4 * units
+        self._remat = bool(remat)
         self._num_layers = num_layers
         self._units = units
         self._num_heads = num_heads
@@ -172,7 +173,7 @@ class ScanTransformerEncoder(HybridBlock):
             ln1_stack_beta, ln2_stack_gamma, ln2_stack_beta,
             lnf_gamma, lnf_beta, num_heads=self._num_heads,
             dropout=self._dropout, activation=self._activation,
-            impl=self._attention_impl)
+            impl=self._attention_impl, remat=self._remat)
 
 
 class BERTModel(HybridBlock):

@@ -153,7 +153,7 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
                              ln1_g, ln1_b, ln2_g, ln2_b, lnf_g, lnf_b,
                              num_heads=1, dropout=0.0,
                              activation="gelu", impl="dense",
-                             _is_training=True, _key=None):
+                             remat=False, _is_training=True, _key=None):
     """Pre-LN transformer trunk as ONE lax.scan over stacked (L, ...)
     per-layer parameters.
 
@@ -203,5 +203,11 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
           ffn2_b, ln1_g, ln1_b, ln2_g, ln2_b)
     if use_drop:
         xs = xs + (jax.random.split(_key, L),)
+    if remat:
+        # per-layer rematerialization: the backward recomputes each
+        # layer's activations from its carry — O(1) layers of
+        # activations resident instead of O(L) (the long-context knob;
+        # composes with the reference's MXNET_BACKWARD_DO_MIRROR story)
+        body = jax.checkpoint(body)
     out, _ = jax.lax.scan(body, data, xs)
     return layer_norm(out, lnf_g, lnf_b)

@@ -251,3 +251,35 @@ def test_transformer_beam_search_beats_or_matches_greedy():
     acc = (out[:, 1:T + 1] == src_np.astype(np.int32)).mean()
     assert acc > 0.9, acc
     assert np.isfinite(sc).all()
+
+
+def test_scan_encoder_remat_identical_grads():
+    """remat=True recomputes layer activations in the backward; grads
+    must be bit-identical to the non-remat scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.attention import scan_transformer_encoder
+
+    rs = np.random.RandomState(0)
+    L, U, H = 3, 16, 2
+    args = [jnp.asarray(a.astype(np.float32)) for a in (
+        rs.randn(2, 4, U),
+        rs.randn(L, 3 * U, U) * 0.1, rs.randn(L, 3 * U) * 0.1,
+        rs.randn(L, U, U) * 0.1, rs.randn(L, U) * 0.1,
+        rs.randn(L, 4 * U, U) * 0.1, rs.randn(L, 4 * U) * 0.1,
+        rs.randn(L, U, 4 * U) * 0.1, rs.randn(L, U) * 0.1,
+        np.ones((L, U)), np.zeros((L, U)),
+        np.ones((L, U)), np.zeros((L, U)),
+        np.ones(U), np.zeros(U))]
+
+    def loss(remat):
+        def f(x):
+            out = scan_transformer_encoder(
+                x, *args[1:], num_heads=H, dropout=0.0, remat=remat)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.grad(f)(args[0])
+
+    g0 = np.asarray(loss(False))
+    g1 = np.asarray(loss(True))
+    np.testing.assert_array_equal(g0, g1)
