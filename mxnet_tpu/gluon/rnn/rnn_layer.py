@@ -28,10 +28,8 @@ class _RNNLayer(HybridBlock):
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC"), \
             f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
-        if projection_size is not None:
-            raise NotImplementedError(
-                "projection_size (LSTMP, reference: rnn.cc projection) is "
-                "not implemented yet in the fused RNN op")
+        if projection_size is not None and mode != "lstm":
+            raise MXNetError("projection_size is LSTM-only (LSTMP)")
         self._hidden_size = hidden_size
         self._projection_size = projection_size
         self._num_layers = num_layers
@@ -47,17 +45,22 @@ class _RNNLayer(HybridBlock):
         self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4,
                        "gru": 3}[mode]
         ng, ni, nh = self._gates, input_size, hidden_size
+        rec = projection_size if projection_size else nh
         for i in range(num_layers):
             for j in ["l", "r"][:self._dir]:
                 self._register_param(f"{j}{i}_i2h_weight", (ng * nh, ni),
                                      i2h_weight_initializer)
-                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, rec),
                                      h2h_weight_initializer)
+                if projection_size:
+                    # LSTMP recurrent projection (reference name: h2r)
+                    self._register_param(f"{j}{i}_h2r_weight", (rec, nh),
+                                         h2h_weight_initializer)
                 self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
                                      i2h_bias_initializer)
                 self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
                                      h2h_bias_initializer)
-            ni = nh * self._dir
+            ni = rec * self._dir
 
     def _register_param(self, name, shape, init):
         p = self.params.get(name, shape=shape, init=init,
@@ -110,10 +113,12 @@ class _RNNLayer(HybridBlock):
 
     def _pack_params(self, F, kwargs):
         parts = []
-        for t in ["weight", "bias"]:
+        conns_w = ["i2h", "h2h"] + (
+            ["h2r"] if self._projection_size else [])
+        for t, conns in (("weight", conns_w), ("bias", ["i2h", "h2h"])):
             for i in range(self._num_layers):
                 for j in ["l", "r"][:self._dir]:
-                    for conn in ["i2h", "h2h"]:
+                    for conn in conns:
                         name = f"{j}{i}_{conn}_{t}"
                         parts.append(F.reshape(kwargs[name], (-1,)))
         return F.concat(*parts, dim=0) if len(parts) > 1 else parts[0]
@@ -140,6 +145,7 @@ class _RNNLayer(HybridBlock):
                     state_size=self._hidden_size,
                     num_layers=self._num_layers, mode=self._mode,
                     bidirectional=self._dir == 2, p=self._dropout,
+                    projection_size=self._projection_size,
                     state_outputs=True)
         if self._mode == "lstm":
             outputs, h, c = out
@@ -188,8 +194,9 @@ class LSTM(_RNNLayer):
                          "lstm", projection_size, **kwargs)
 
     def state_info(self, batch_size=0):
+        rec = self._projection_size or self._hidden_size
         return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"},
+                           rec), "__layout__": "LNC"},
                 {"shape": (self._num_layers * self._dir, batch_size,
                            self._hidden_size), "__layout__": "LNC"}]
 

@@ -25,33 +25,46 @@ _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 def rnn_param_size(mode, input_size, state_size, num_layers=1,
                    bidirectional=False, projection_size=None):
-    """Total packed parameter count (reference: RNNParam size calc)."""
+    """Total packed parameter count (reference: RNNParam size calc,
+    incl. the LSTMP projection rows when projection_size is set)."""
     gates = _GATES[mode]
     dirs = 2 if bidirectional else 1
+    P = projection_size
+    rec = P if P else state_size          # recurrent/output width
     size = 0
     for layer in range(num_layers):
-        in_sz = input_size if layer == 0 else state_size * dirs
+        in_sz = input_size if layer == 0 else rec * dirs
         for _ in range(dirs):
-            size += gates * state_size * (in_sz + state_size)  # Wx, Wh
+            size += gates * state_size * (in_sz + rec)         # Wx, Wh
+            if P:
+                size += P * state_size                         # Wr
             size += 2 * gates * state_size                     # bx, bh
     return size
 
 
-def _unpack_params(params, mode, input_size, state_size, num_layers, dirs):
-    """Split the packed vector into per-layer/direction (Wx, Wh, bx, bh)."""
+def _unpack_params(params, mode, input_size, state_size, num_layers,
+                   dirs, projection_size=None):
+    """Split the packed vector into per-layer/direction
+    (Wx, Wh[, Wr], bx, bh)."""
     gates = _GATES[mode]
     H = state_size
+    P = projection_size
+    rec = P if P else H
     weights, biases = [], []
     off = 0
     for layer in range(num_layers):
-        in_sz = input_size if layer == 0 else H * dirs
+        in_sz = input_size if layer == 0 else rec * dirs
         for _ in range(dirs):
             wx = params[off:off + gates * H * in_sz].reshape(
                 gates * H, in_sz)
             off += gates * H * in_sz
-            wh = params[off:off + gates * H * H].reshape(gates * H, H)
-            off += gates * H * H
-            weights.append((wx, wh))
+            wh = params[off:off + gates * H * rec].reshape(gates * H, rec)
+            off += gates * H * rec
+            wr = None
+            if P:
+                wr = params[off:off + P * H].reshape(P, H)
+                off += P * H
+            weights.append((wx, wh, wr))
     for layer in range(num_layers):
         for _ in range(dirs):
             bx = params[off:off + gates * H]
@@ -62,7 +75,7 @@ def _unpack_params(params, mode, input_size, state_size, num_layers, dirs):
     return weights, biases
 
 
-def _cell_step(mode, H):
+def _cell_step(mode, H, wr=None):
     if mode == "lstm":
         def step(carry, xproj, wh, bh):
             h, c = carry
@@ -73,6 +86,8 @@ def _cell_step(mode, H):
             g = jnp.tanh(g)
             c = f * c + i * g
             h = o * jnp.tanh(c)
+            if wr is not None:  # LSTMP: project the recurrent output
+                h = h @ wr.T
             return (h, c), h
         return step
     if mode == "gru":
@@ -96,10 +111,10 @@ def _cell_step(mode, H):
     return step
 
 
-def _run_direction(x, h0, c0, wx, wh, bx, bh, mode, reverse):
-    """x: (T,B,in) → outputs (T,B,H), final (h, c?)."""
+def _run_direction(x, h0, c0, wx, wh, bx, bh, mode, reverse, wr=None):
+    """x: (T,B,in) → outputs (T,B,H|P), final (h, c?)."""
     H = wh.shape[1]
-    step = _cell_step(mode, H)
+    step = _cell_step(mode, H, wr)
     xproj = jnp.einsum("tbi,gi->tbg", x, wx,
                        preferred_element_type=jnp.float32) \
         .astype(x.dtype) + bx
@@ -129,7 +144,7 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
     H = state_size
     dirs = 2 if bidirectional else 1
     weights, biases = _unpack_params(parameters, mode, input_size, H,
-                                     num_layers, dirs)
+                                     num_layers, dirs, projection_size)
     x = data
     h_finals, c_finals = [], []
     key = _key
@@ -137,12 +152,12 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
         outs_dir = []
         for d in range(dirs):
             idx = layer * dirs + d
-            wx, wh = weights[idx]
+            wx, wh, wr = weights[idx]
             bx, bh = biases[idx]
             h0 = state[idx]
             c0 = state_cell[idx] if mode == "lstm" else None
             outs, final = _run_direction(x, h0, c0, wx, wh, bx, bh, mode,
-                                         reverse=(d == 1))
+                                         reverse=(d == 1), wr=wr)
             outs_dir.append(outs)
             h_finals.append(final[0])
             if mode == "lstm":

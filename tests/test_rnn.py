@@ -181,3 +181,62 @@ def test_rnn_dropout_between_layers():
     c = lstm(x).asnumpy()
     d = lstm(x).asnumpy()
     np.testing.assert_allclose(c, d)
+
+
+def test_lstmp_projection():
+    """LSTMP (reference: rnn.cc projection_size): recurrent/output width
+    P != cell width H; oracle-checked single step + trains."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    H, P, I, T, B = 8, 5, 4, 6, 3
+    net = gluon.rnn.LSTM(H, num_layers=2, projection_size=P,
+                         input_size=I)
+    net.initialize(init=mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(T, B, I)
+                 .astype("float32"))
+    out = net(x)
+    assert out.shape == (T, B, P), out.shape
+    states = net.begin_state(batch_size=B)
+    assert states[0].shape == (2, B, P)   # h is projected
+    assert states[1].shape == (2, B, H)   # c keeps cell width
+    out2, new_states = net(x, states)
+    assert new_states[0].shape == (2, B, P)
+    assert new_states[1].shape == (2, B, H)
+
+    # single-layer numeric oracle
+    net1 = gluon.rnn.LSTM(H, num_layers=1, projection_size=P,
+                          input_size=I)
+    net1.initialize(init=mx.init.Xavier())
+    wx = [v for n, v in net1.collect_params().items()
+          if n.endswith("i2h_weight")][0].data().asnumpy()
+    wh = [v for n, v in net1.collect_params().items()
+          if n.endswith("h2h_weight")][0].data().asnumpy()
+    wr = [v for n, v in net1.collect_params().items()
+          if n.endswith("h2r_weight")][0].data().asnumpy()
+    bx = [v for n, v in net1.collect_params().items()
+          if n.endswith("i2h_bias")][0].data().asnumpy()
+    bh = [v for n, v in net1.collect_params().items()
+          if n.endswith("h2h_bias")][0].data().asnumpy()
+    xs = np.random.RandomState(1).randn(2, 1, I).astype("float32")
+    out = net1(nd.array(xs)).asnumpy()
+
+    def sigmoid(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    h = np.zeros((1, P), np.float32)
+    c = np.zeros((1, H), np.float32)
+    for t in range(2):
+        gates = xs[t] @ wx.T + bx + h @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = (sigmoid(o) * np.tanh(c)) @ wr.T
+        np.testing.assert_allclose(out[t], h, atol=1e-5)
+
+    # gradient flows through the projection
+    xg = nd.array(xs)
+    xg.attach_grad()
+    with autograd.record():
+        loss = (net1(xg) ** 2).sum()
+    loss.backward()
+    assert float(np.abs(xg.grad.asnumpy()).sum()) > 0
