@@ -91,10 +91,11 @@ class _RNNLayer(HybridBlock):
     def infer_shape(self, x, *args):
         ni = x.shape[2] if self._layout == "TNC" else x.shape[2]
         ng, nh = self._gates, self._hidden_size
+        rec = self._projection_size or nh
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
                 getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
-            ni = nh * self._dir
+            ni = rec * self._dir
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
         """Initial recurrent states (reference: _RNNLayer.begin_state)."""

@@ -75,7 +75,7 @@ def _unpack_params(params, mode, input_size, state_size, num_layers,
     return weights, biases
 
 
-def _cell_step(mode, H, wr=None):
+def _cell_step(mode, wr=None):
     if mode == "lstm":
         def step(carry, xproj, wh, bh):
             h, c = carry
@@ -111,21 +111,39 @@ def _cell_step(mode, H, wr=None):
     return step
 
 
-def _run_direction(x, h0, c0, wx, wh, bx, bh, mode, reverse, wr=None):
-    """x: (T,B,in) → outputs (T,B,H|P), final (h, c?)."""
-    H = wh.shape[1]
-    step = _cell_step(mode, H, wr)
+def _run_direction(x, h0, c0, wx, wh, bx, bh, mode, reverse, wr=None,
+                   seq_len=None):
+    """x: (T,B,in) → outputs (T,B,H|P), final (h, c?).
+
+    With ``seq_len`` (B,), steps at t >= len neither update the carry
+    nor emit output (reference use_sequence_length masking); the
+    reversed direction runs the global flip, so invalid tail steps are
+    frozen no-ops and each sequence is effectively reversed within its
+    own valid region.
+    """
+    T = x.shape[0]
+    step = _cell_step(mode, wr)
     xproj = jnp.einsum("tbi,gi->tbg", x, wx,
                        preferred_element_type=jnp.float32) \
         .astype(x.dtype) + bx
+    ts = jnp.arange(T)
     if reverse:
         xproj = jnp.flip(xproj, axis=0)
+        ts = jnp.flip(ts, axis=0)
     carry0 = (h0, c0) if mode == "lstm" else (h0,)
 
-    def scan_fn(carry, xp):
-        return step(carry, xp, wh, bh)
+    def scan_fn(carry, inp):
+        xp, t = inp
+        new_carry, out = step(carry, xp, wh, bh)
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            new_carry = tuple(
+                jnp.where(valid, n, o)
+                for n, o in zip(new_carry, carry))
+            out = jnp.where(valid, out, jnp.zeros((), out.dtype))
+        return new_carry, out
 
-    final, outs = lax.scan(scan_fn, carry0, xproj)
+    final, outs = lax.scan(scan_fn, carry0, (xproj, ts))
     if reverse:
         outs = jnp.flip(outs, axis=0)
     return outs, final
@@ -143,6 +161,12 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
     T, B, input_size = data.shape
     H = state_size
     dirs = 2 if bidirectional else 1
+    seq_len = None
+    if use_sequence_length:
+        if sequence_length is None:
+            raise ValueError("use_sequence_length=True needs "
+                             "sequence_length (B,)")
+        seq_len = sequence_length.astype(jnp.int32)
     weights, biases = _unpack_params(parameters, mode, input_size, H,
                                      num_layers, dirs, projection_size)
     x = data
@@ -157,7 +181,8 @@ def rnn(data, parameters, state, state_cell=None, state_size=None,
             h0 = state[idx]
             c0 = state_cell[idx] if mode == "lstm" else None
             outs, final = _run_direction(x, h0, c0, wx, wh, bx, bh, mode,
-                                         reverse=(d == 1), wr=wr)
+                                         reverse=(d == 1), wr=wr,
+                                         seq_len=seq_len)
             outs_dir.append(outs)
             h_finals.append(final[0])
             if mode == "lstm":

@@ -240,3 +240,86 @@ def test_lstmp_projection():
         loss = (net1(xg) ** 2).sum()
     loss.backward()
     assert float(np.abs(xg.grad.asnumpy()).sum()) > 0
+
+
+def test_rnn_use_sequence_length_masks_correctly():
+    """use_sequence_length (reference: rnn.cc masked RNN): padded steps
+    must not advance state or emit output; per-sequence result equals
+    running each unpadded sequence alone."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.rnn import rnn, rnn_param_size
+
+    rs = np.random.RandomState(0)
+    T, B, I, H = 6, 3, 4, 5
+    lens = np.array([6, 3, 1], np.int32)
+    x = rs.randn(T, B, I).astype(np.float32)
+    x_pad = x.copy()
+    for b, L in enumerate(lens):
+        x_pad[L:, b] = 99.0  # garbage beyond length must not matter
+    n = rnn_param_size("lstm", I, H)
+    params = jnp.asarray(rs.randn(n).astype(np.float32) * 0.2)
+    h0 = jnp.zeros((1, B, H), jnp.float32)
+    c0 = jnp.zeros((1, B, H), jnp.float32)
+
+    out, hT, cT = rnn(jnp.asarray(x_pad), params, h0, c0, state_size=H,
+                      mode="lstm", state_outputs=True,
+                      use_sequence_length=True,
+                      sequence_length=jnp.asarray(lens))
+    out = np.asarray(out)
+    for b, L in enumerate(lens):
+        # reference per-sequence run (unpadded, batch of 1)
+        ob, hb, cb = rnn(jnp.asarray(x[:L, b:b + 1]), params,
+                         h0[:, :1], c0[:, :1], state_size=H,
+                         mode="lstm", state_outputs=True)
+        np.testing.assert_allclose(out[:L, b], np.asarray(ob)[:, 0],
+                                   atol=1e-5)
+        assert np.abs(out[L:, b]).max() == 0 if L < 6 else True
+        np.testing.assert_allclose(np.asarray(hT)[0, b],
+                                   np.asarray(hb)[0, 0], atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT)[0, b],
+                                   np.asarray(cb)[0, 0], atol=1e-5)
+
+
+def test_rnn_bidirectional_sequence_length():
+    """Reverse direction with seq_len: each sequence reversed within
+    its own valid region (global-flip + frozen invalid steps)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.rnn import rnn, rnn_param_size
+
+    rs = np.random.RandomState(1)
+    T, B, I, H = 5, 2, 3, 4
+    lens = np.array([5, 3], np.int32)
+    x = rs.randn(T, B, I).astype(np.float32)
+    n = rnn_param_size("gru", I, H, bidirectional=True)
+    params = jnp.asarray(rs.randn(n).astype(np.float32) * 0.2)
+    h0 = jnp.zeros((2, B, H), jnp.float32)
+    out, _ = rnn(jnp.asarray(x), params, h0, state_size=H, mode="gru",
+                 bidirectional=True, state_outputs=True,
+                 use_sequence_length=True,
+                 sequence_length=jnp.asarray(lens))
+    out = np.asarray(out)
+    # sequence 1 (len 3): compare against the unpadded bidirectional run
+    ob, _ = rnn(jnp.asarray(x[:3, 1:2]), params, h0[:, :1],
+                state_size=H, mode="gru", bidirectional=True,
+                state_outputs=True)
+    np.testing.assert_allclose(out[:3, 1], np.asarray(ob)[:, 0],
+                               atol=1e-5)
+
+
+def test_lstmp_deferred_input_size():
+    """Review regression: deferred init (input_size=0) must infer
+    layer>0 input width from the PROJECTED size."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    net = gluon.rnn.LSTM(8, num_layers=2, projection_size=5)
+    net.initialize(init=mx.init.Xavier())
+    x = nd.array(np.random.RandomState(2).randn(4, 2, 3)
+                 .astype("float32"))
+    out = net(x)  # deferred shapes resolve here
+    assert out.shape == (4, 2, 5)
+    w = [p for n, p in net.collect_params().items()
+         if n.endswith("l1_i2h_weight")][0]
+    assert w.shape == (4 * 8, 5), w.shape
