@@ -353,6 +353,26 @@ class HybridBlock(Block):
     def infer_type(self, *args):
         pass
 
+    def optimize_for(self, x, backend="XLA", **kwargs):
+        """Partition this block's traced graph for a subgraph backend
+        and return a SymbolBlock running the partitioned graph with the
+        current parameters bound (reference: HybridBlock.optimize_for,
+        ≥1.6 — the MKLDNN/TensorRT offload entry).  ``x`` warms the
+        trace exactly like the reference's sample input."""
+        from .. import symbol as _sym
+
+        if not self._active:
+            self.hybridize()
+        self(x)  # materialize deferred shapes / build the cache
+        sym = _sym.trace_block(self)
+        psym = sym.optimize_for(backend, **kwargs)
+        sb = SymbolBlock(psym, [_sym.var("data")])
+        params = self.collect_params()
+        for name, p in sb.params.items():
+            if name in params:
+                p._load_init(params[name].data(), None, cast_dtype=True)
+        return sb
+
     def export(self, path, epoch=0):
         """Serialize to symbol.json + params (reference: HybridBlock.export
         → the deploy format)."""

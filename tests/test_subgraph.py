@@ -152,3 +152,22 @@ def test_partition_multioutput_member_not_duplicated():
     names = [n.name for n in p._topo() if n.op]
     bn_nodes = [nm for nm in names if "batchnorm" in nm]
     assert not bn_nodes, f"BatchNorm duplicated outside region: {bn_nodes}"
+
+
+def test_hybridblock_optimize_for():
+    """gluon entry (reference: HybridBlock.optimize_for >=1.6): trace,
+    partition, return a bound SymbolBlock with identical outputs."""
+    from mxnet_tpu import autograd, gluon, nd
+
+    rs = np.random.RandomState(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.array(rs.randn(3, 8).astype("float32"))
+    with autograd.predict_mode():
+        opt = net.optimize_for(x)
+        ref = net(x)
+        out = opt(x)
+    ops = [n.op for n in opt._outputs_sym._topo() if n.op]
+    assert "_subgraph_exec" in ops, ops
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=1e-6)
