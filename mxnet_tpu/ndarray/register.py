@@ -23,6 +23,28 @@ from ..ops.registry import OpDef
 from .ndarray import NDArray, _from_jax
 
 
+_EAGER_OP_TRACE = 0
+
+
+def in_eager_op_trace():
+    """True while an op body is being traced by the EAGER autograd path's
+    per-op jax.vjp (as opposed to an enclosing user/CachedOp jit).  Mesh
+    ops (ring/ulysses) use this to know their tracer inputs carry
+    committed single-device primals that must be resharded in and brought
+    back out."""
+    return _EAGER_OP_TRACE > 0
+
+
+class _eager_op_trace:
+    def __enter__(self):
+        global _EAGER_OP_TRACE
+        _EAGER_OP_TRACE += 1
+
+    def __exit__(self, *exc):
+        global _EAGER_OP_TRACE
+        _EAGER_OP_TRACE -= 1
+
+
 def _inject(opdef: OpDef, kwargs: dict) -> dict:
     if opdef.mode_dependent and kwargs.get("_is_training") is None:
         kwargs = dict(kwargs)
@@ -115,7 +137,8 @@ def _invoke_inner(opdef: OpDef, fn, args: tuple, kwargs: dict):
     if recording:
         import jax
 
-        out, vjp_fn = jax.vjp(pure_fn, *arrs)
+        with _eager_op_trace():
+            out, vjp_fn = jax.vjp(pure_fn, *arrs)
         single = not isinstance(out, (tuple, list))
         outs_j = [out] if single else list(out)
         outs = [_wrap(o, ctx) for o in outs_j]

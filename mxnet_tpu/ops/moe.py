@@ -24,14 +24,23 @@ import jax.numpy as jnp
 from .registry import register
 
 
-def _top1_dispatch(probs, capacity):
-    """probs: (N, E) → dispatch (N, E, C) one-hot, combine (N, E, C)."""
+def _top1_dispatch(probs, capacity, base_counts):
+    """probs: (N, E) → dispatch (N, E, C) one-hot, combine (N, E, C).
+
+    ``base_counts`` (E,) is the number of slots each expert already has
+    occupied by earlier top-1 rounds; this round's queue positions start
+    after them (GShard: second-choice positions begin after all kept
+    first-choice tokens), so rounds never collide on a capacity slot.
+    Also returns the updated per-expert occupied-slot counts and this
+    round's (N, E) selection one-hot (the caller masks with it).
+    """
     n, e = probs.shape
     gate = jnp.max(probs, axis=1)                      # (N,)
     idx = jnp.argmax(probs, axis=1)                    # (N,)
     sel = jax.nn.one_hot(idx, e, dtype=probs.dtype)    # (N, E)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(sel, axis=0) * sel - sel          # (N, E), 0-based
+    # position of each token within its expert's queue, offset by the
+    # slots earlier rounds already filled
+    pos = (jnp.cumsum(sel, axis=0) - 1.0 + base_counts[None, :]) * sel
     pos_tok = jnp.sum(pos, axis=1)                     # (N,)
     keep = pos_tok < capacity
     gate = gate * keep.astype(probs.dtype)
@@ -39,7 +48,9 @@ def _top1_dispatch(probs, capacity):
         pos_tok, capacity, dtype=probs.dtype)[:, None, :]
     dispatch = dispatch * keep[:, None, None].astype(probs.dtype)
     combine = dispatch * gate[:, None, None]
-    return dispatch, combine
+    new_counts = base_counts + jnp.sum(
+        sel * keep[:, None].astype(probs.dtype), axis=0)
+    return dispatch, combine, new_counts, sel
 
 
 @register("moe_ffn", aliases=("MoEFFN_op",))
@@ -67,13 +78,15 @@ def moe_ffn(data, gate_weight, w1, b1, w2, b2, num_experts=None, k=1,
     dispatch = jnp.zeros((n, e, capacity), probs.dtype)
     combine = jnp.zeros((n, e, capacity), probs.dtype)
     masked = probs
+    counts = jnp.zeros((e,), probs.dtype)
     for _ in range(int(k)):
-        d_i, c_i = _top1_dispatch(masked, capacity)
+        d_i, c_i, counts, sel_i = _top1_dispatch(masked, capacity, counts)
         dispatch = jnp.maximum(dispatch, d_i)
         combine = combine + c_i
-        # mask out the chosen expert for the next pick
-        chosen = jnp.sum(d_i, axis=2)  # (N, E) 0/1
-        masked = masked * (1.0 - chosen)
+        # mask out the chosen expert for the next pick (by argmax
+        # selection, not by kept slot — a dropped token must not re-pick
+        # the same, full expert)
+        masked = masked * (1.0 - sel_i)
     if k > 1:
         # renormalize combine weights over the k picks (GShard top-2)
         denom = jnp.sum(combine, axis=(1, 2), keepdims=True)

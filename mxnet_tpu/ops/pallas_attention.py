@@ -131,7 +131,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[...] + jnp.log(lsafe))[:, :_LSE_LANES]
 
 
-def _flash_call(q, k, v, causal, scale, block_q, block_k):
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct that carries the varying-mesh-axes set when the
+    kernel runs inside a check_vma=True shard_map (ring attention)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_call(q, k, v, causal, scale, block_q, block_k, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -161,10 +169,10 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k):
                          lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            _sds((B * H, T, D), q.dtype, vma),
             # logsumexp, ×8 sublane-replicated (narrowest Mosaic-legal
             # lane tile — ×128 would cost 16× the HBM for no information)
-            jax.ShapeDtypeStruct((B * H, T, _LSE_LANES), jnp.float32),
+            _sds((B * H, T, _LSE_LANES), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -287,7 +295,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dk_ref,
 
 
 def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
-                    block_k):
+                    block_k, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -313,7 +321,7 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
         grid=(B * H, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, qspec, lspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=_sds((B * H, T, D), q.dtype, vma),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -334,8 +342,8 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
         in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+            _sds((B * H, T, D), k.dtype, vma),
+            _sds((B * H, T, D), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),

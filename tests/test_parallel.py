@@ -61,6 +61,52 @@ def test_ring_attention_differentiable(qkv):
                                rtol=2e-3, atol=2e-4)
 
 
+def test_ring_attention_flash_no_dense_scores_in_hlo():
+    """VERDICT r3 task #3 'done' criterion: with the Pallas path, the
+    sharded program contains NO (Tq/P × Tk/P) score tensor — per-step
+    memory is tile-bounded.  Small tile overrides (8×8) at Tloc=32 make
+    a 32×32 intermediate the dense-path signature to assert against."""
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 256, 16).astype(np.float32))
+               for _ in range(3))
+    mesh = parallel.make_mesh(sp=8)
+
+    def flash(q, k, v):
+        return parallel.ring_attention(q, k, v, mesh=mesh, causal=True,
+                                       block_q=8, block_k=8)
+
+    def dense(q, k, v):
+        return parallel.ring_attention(q, k, v, mesh=mesh, causal=True,
+                                       impl="dense")
+
+    txt_flash = jax.jit(flash).lower(q, k, v).as_text()
+    txt_dense = jax.jit(dense).lower(q, k, v).as_text()
+    assert "32x32xf32" in txt_dense      # the test can detect the tensor
+    assert "32x32xf32" not in txt_flash  # ...and flash never builds it
+
+
+def test_ring_attention_flash_long_seq_sharded():
+    """T=32768 global causal over an 8-way sp ring (Tloc=4096, streamed
+    2048-tile kernel): last 64 rows attend to the whole sequence, checked
+    against a dense numpy oracle."""
+    rng = np.random.RandomState(5)
+    T, D = 32768, 8
+    q, k, v = (rng.randn(1, 1, T, D).astype(np.float32) for _ in range(3))
+    mesh = parallel.make_mesh(sp=8)
+    out = parallel.ring_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh,
+        causal=True, block_q=2048, block_k=2048)
+    rows = slice(T - 64, T)
+    s = q[0, 0, rows] @ k[0, 0].T * (D ** -0.5)   # (64, T)
+    mask = np.arange(T)[None, :] <= np.arange(T - 64, T)[:, None]
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    ref = p @ v[0, 0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0, rows], ref,
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_ulysses_matches_dense(qkv):
     q, k, v = qkv
     mesh = parallel.make_mesh(sp=8)
@@ -569,6 +615,70 @@ def test_moe_ffn_top1_matches_dense_oracle():
         expect[t] = probs[t, ei] * (h @ np.asarray(w2)[ei]
                                     + np.asarray(b2)[ei])
     np.testing.assert_allclose(y, expect, atol=1e-4)
+
+
+def test_moe_ffn_top2_matches_dense_oracle():
+    """With capacity ≥ tokens, GShard top-2 MoE == renormalized sum of
+    the two argmax experts' FFNs (regression: round-2 capacity slots
+    must not collide with round-1 slots)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.moe import moe_ffn
+
+    rs = np.random.RandomState(3)
+    n, m, f, e = 16, 8, 16, 4
+    x = jnp.asarray(rs.randn(n, m).astype(np.float32))
+    gw = jnp.asarray(rs.randn(e, m).astype(np.float32))
+    w1 = jnp.asarray(rs.randn(e, m, f).astype(np.float32) * 0.1)
+    b1 = jnp.asarray(rs.randn(e, f).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rs.randn(e, f, m).astype(np.float32) * 0.1)
+    b2 = jnp.asarray(rs.randn(e, m).astype(np.float32) * 0.1)
+
+    y = np.asarray(moe_ffn(x, gw, w1, b1, w2, b2, num_experts=e, k=2,
+                           capacity_factor=float(n)))  # no overflow
+    logits = np.asarray(x) @ np.asarray(gw).T
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    expect = np.zeros((n, m), np.float32)
+    for t in range(n):
+        order = np.argsort(-probs[t])
+        e1, e2 = order[0], order[1]
+        acc = np.zeros(m, np.float32)
+        for ei, p in ((e1, probs[t, e1]), (e2, probs[t, e2])):
+            h = np.maximum(np.asarray(x)[t] @ np.asarray(w1)[ei]
+                           + np.asarray(b1)[ei], 0)
+            acc += p * (h @ np.asarray(w2)[ei] + np.asarray(b2)[ei])
+        expect[t] = acc / (probs[t, e1] + probs[t, e2])
+    np.testing.assert_allclose(y, expect, atol=1e-4)
+
+
+def test_moe_ffn_top2_slots_do_not_collide():
+    """Force every token's 1st pick to expert 0 and 2nd to expert 1:
+    expert 1's queue must start at slot len(kept-in-0) — with the
+    pre-fix maximum-merge, slot 0 of expert 0 held two tokens' sum."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.moe import moe_ffn
+
+    n, m, e = 4, 4, 2
+    x = jnp.asarray(np.eye(n, m, dtype=np.float32))
+    gw = jnp.asarray(np.array([[3.0] * m, [1.0] * m], np.float32))
+    # identity-ish experts so the output is attributable per token
+    w1 = jnp.stack([jnp.eye(m), 2 * jnp.eye(m)]).astype(jnp.float32)
+    b1 = jnp.zeros((e, m), jnp.float32)
+    w2 = jnp.stack([jnp.eye(m), jnp.eye(m)]).astype(jnp.float32)
+    b2 = jnp.zeros((e, m), jnp.float32)
+    # capacity_factor 2.0 with e=2, n=4 -> capacity 4: both rounds fit
+    y = np.asarray(moe_ffn(x, gw, w1, b1, w2, b2, num_experts=e, k=2,
+                           capacity_factor=2.0))
+    # oracle: every token routes (p0, p1) to experts (id, 2·id);
+    # renormalized combine -> y_t = (p0·x_t + p1·2·x_t)/(p0+p1)
+    logits = np.asarray(x) @ np.asarray(gw).T
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    scale = (probs[:, 0] + 2 * probs[:, 1]) / (probs[:, 0] + probs[:, 1])
+    expect = np.asarray(x) * scale[:, None]
+    np.testing.assert_allclose(y, expect, atol=1e-5)
 
 
 def test_moe_ffn_capacity_drops_overflow():
