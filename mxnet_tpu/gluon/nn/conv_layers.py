@@ -64,7 +64,7 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def infer_shape(self, x, *args):
-        in_channels = x.shape[1]
+        in_channels = x.shape[self._kwargs["layout"].index("C")]
         groups = self._kwargs["num_group"]
         k = tuple(self._kwargs["kernel"])
         if self._op_name == "Convolution":
@@ -151,7 +151,7 @@ class Conv3D(_Conv):
 
 class _ConvTranspose(_Conv):
     def infer_shape(self, x, *args):
-        in_channels = x.shape[1]
+        in_channels = x.shape[self._kwargs["layout"].index("C")]
         groups = self._kwargs["num_group"]
         k = tuple(self._kwargs["kernel"])
         self.weight.shape = (in_channels, self._channels // groups) + k
@@ -233,7 +233,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -256,7 +257,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
-        assert layout == "NCW", "Only supports NCW layout for now"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "max", layout, **kwargs)
 
@@ -266,7 +267,7 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
-        assert layout == "NCHW", "Only supports NCHW layout for now"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "max", layout, **kwargs)
 
@@ -276,7 +277,7 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
-        assert layout == "NCDHW", "Only supports NCDHW layout for now"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "max", layout, **kwargs)
 
@@ -286,7 +287,7 @@ class AvgPool1D(_Pooling):
                  ceil_mode=False, count_include_pad=True, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
-        assert layout == "NCW", "Only supports NCW layout for now"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "avg", layout, count_include_pad, **kwargs)
 
@@ -297,7 +298,7 @@ class AvgPool2D(_Pooling):
                  **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
-        assert layout == "NCHW", "Only supports NCHW layout for now"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "avg", layout, count_include_pad, **kwargs)
 
@@ -308,47 +309,47 @@ class AvgPool3D(_Pooling):
                  **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
-        assert layout == "NCDHW", "Only supports NCDHW layout for now"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(pool_size, strides, padding, ceil_mode, False,
                          "avg", layout, count_include_pad, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW", "Only supports NCW layout for now"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__((1,), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only supports NCHW layout for now"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__((1, 1), None, 0, True, True, "max", layout,
                          **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only supports NCDHW layout for now"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__((1, 1, 1), None, 0, True, True, "max", layout,
                          **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__((1,), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__((1, 1), None, 0, True, True, "avg", layout,
                          **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__((1, 1, 1), None, 0, True, True, "avg", layout,
                          **kwargs)
 

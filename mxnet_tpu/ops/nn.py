@@ -222,19 +222,37 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
 
 # -- convolution ---------------------------------------------------------------
 
-def _conv_dn(ndim):
-    if ndim == 3:
-        return ("NCH", "OIH", "NCH")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    return ("NCDHW", "OIDHW", "NCDHW")
+_DEFAULT_CONV_LAYOUT = {3: "NCW", 4: "NCHW", 5: "NCDHW"}
+
+
+def _conv_dn(ndim, layout=None):
+    """Dimension-number spec for a given data layout.
+
+    The WEIGHT layout is always OI+spatial regardless of data layout —
+    parameters stay layout-portable (an NCHW checkpoint loads into an
+    NHWC model unchanged); XLA relayouts for the MXU internally.  The
+    reference's NHWC conv instead expects NHWC weights
+    (src/operator/nn/convolution.cc layout switch) — divergence is
+    deliberate and documented in docs/perf.md.
+    """
+    lhs = layout or _DEFAULT_CONV_LAYOUT[ndim]
+    if len(lhs) != ndim or set("NC") - set(lhs):
+        raise ValueError(f"bad conv layout {lhs!r} for {ndim}d data")
+    rhs = "OI" + "".join(c for c in lhs if c not in "NC")
+    return (lhs, rhs, lhs)
+
+
+def _channel_pos(ndim, layout):
+    return (layout or _DEFAULT_CONV_LAYOUT[ndim]).index("C")
 
 
 @register("Convolution", aliases=("convolution",))
 def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
                 cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
-    """Grouped N-D convolution, NCHW/OIHW (reference layout).
+    """Grouped N-D convolution, NCHW/OIHW (reference layout) or
+    channels-last via ``layout`` ("NHWC"/"NWC"/"NDHWC"; weights stay
+    OI+spatial — see _conv_dn).
 
     XLA maps this to the MXU; bf16 inputs accumulate in f32 via
     preferred_element_type (the TPU-native analog of cuDNN tensor-core math).
@@ -244,7 +262,8 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = _pair(stride or 1, spatial)
     dilate = _pair(dilate or 1, spatial)
     pad = _pair(pad or 0, spatial)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dn(nd, layout))
     out = _conv_f32_accum(
         data, weight,
         window_strides=stride,
@@ -254,7 +273,9 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         feature_group_count=num_group,
     ).astype(data.dtype)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * spatial)
+        bshape = [1] * nd
+        bshape[_channel_pos(nd, layout)] = -1
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -272,32 +293,34 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
     pad = _pair(pad or 0, spatial)
     adj = _pair(adj or 0, spatial)
     kshape = weight.shape[2:]
+    cpos = _channel_pos(nd, layout)
     # conv_transpose padding that inverts a forward conv with `pad`:
     padding = []
     for k, p, a, d in zip(kshape, pad, adj, dilate):
         keff = (k - 1) * d + 1
         padding.append((keff - 1 - p, keff - 1 - p + a))
     if num_group != 1:
-        groups_in = jnp.split(data, num_group, axis=1)
+        groups_in = jnp.split(data, num_group, axis=cpos)
         groups_w = jnp.split(weight, num_group, axis=0)
-        outs = [_deconv_one(x, w, stride, padding, dilate)
+        outs = [_deconv_one(x, w, stride, padding, dilate, layout)
                 for x, w in zip(groups_in, groups_w)]
-        out = jnp.concatenate(outs, axis=1)
+        out = jnp.concatenate(outs, axis=cpos)
     else:
-        out = _deconv_one(data, weight, stride, padding, dilate)
+        out = _deconv_one(data, weight, stride, padding, dilate, layout)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * spatial)
+        bshape = [1] * nd
+        bshape[cpos] = -1
+        out = out + bias.reshape(bshape)
     return out
 
 
-def _deconv_one(data, weight, stride, padding, dilate):
+def _deconv_one(data, weight, stride, padding, dilate, layout=None):
     nd = data.ndim
-    dn = lax.conv_dimension_numbers(
-        data.shape, weight.shape, _conv_dn(nd))
     # lhs_dilation implements the fractional stride of conv_transpose.
     w = jnp.flip(weight, axis=tuple(range(2, nd)))
     w = jnp.swapaxes(w, 0, 1)  # IO* -> OI* for the underlying conv
-    dn2 = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dn(nd))
+    dn2 = lax.conv_dimension_numbers(data.shape, w.shape,
+                                     _conv_dn(nd, layout))
     return _conv_f32_accum(
         data, w,
         window_strides=(1,) * (nd - 2),
@@ -315,8 +338,10 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
             count_include_pad=True, cudnn_off=False, layout=None, p_value=2):
     spatial = data.ndim - 2
+    cpos = _channel_pos(data.ndim, layout)
+    sp_axes = tuple(i for i in range(1, data.ndim) if i != cpos)
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = sp_axes
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -329,19 +354,24 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
     kernel = _pair(kernel, spatial)
     stride = _pair(stride or 1, spatial)
     pad = _pair(pad or 0, spatial)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    for ax, k, s in zip(sp_axes, kernel, stride):
+        window[ax], strides[ax] = k, s
+    window, strides = tuple(window), tuple(strides)
     if pooling_convention == "full":
         # ceil-mode: pad up so that ceil((x + 2p - k)/s) windows fit
         padding = []
-        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
-            x = data.shape[2 + i]
+        for ax, k, s, p in zip(sp_axes, kernel, stride, pad):
+            x = data.shape[ax]
             out = -(-(x + 2 * p - k) // s) + 1  # ceil division
             needed = max((out - 1) * s + k - x - p, p)
             padding.append((p, needed))
     else:
         padding = [(p, p) for p in pad]
-    padconf = [(0, 0), (0, 0)] + padding
+    padconf = [(0, 0)] * data.ndim
+    for ax, pp in zip(sp_axes, padding):
+        padconf[ax] = pp
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min

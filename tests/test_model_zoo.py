@@ -45,6 +45,52 @@ def test_resnet50_structure():
     assert 24e6 < n_params < 27e6, n_params
 
 
+def test_resnet_nhwc_matches_nchw(tmp_path):
+    """layout='NHWC' ResNet (the BASELINE.md layout experiment) computes
+    the SAME function as the NCHW model: parameters are layout-portable
+    (weights stay OIHW), so an NCHW checkpoint loads into the NHWC
+    variant and the outputs match on transposed input — fwd and grads."""
+    from mxnet_tpu import autograd
+
+    net = vision.get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.random_normal(shape=(2, 3, 32, 32))
+    x.attach_grad()
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    ref, gref = out.asnumpy(), x.grad.asnumpy()
+
+    f = str(tmp_path / "r18.params")
+    net.save_parameters(f)
+    net2 = vision.get_model("resnet18_v1", classes=4, thumbnail=True,
+                            layout="NHWC")
+    net2.load_parameters(f)
+    x2 = mx.nd.array(np.transpose(x.asnumpy(), (0, 2, 3, 1)))
+    x2.attach_grad()
+    with autograd.record():
+        out2 = net2(x2)
+        loss2 = (out2 * out2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(out2.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.transpose(x2.grad.asnumpy(), (0, 3, 1, 2)), gref,
+        rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_nhwc_structure():
+    """The bench NHWC config (resnet50_v1 layout='NHWC') builds, forwards
+    and keeps the NCHW parameter count."""
+    net = vision.resnet50_v1(classes=1000, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    out = net(mx.nd.random_normal(shape=(1, 64, 64, 3)))
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    assert 24e6 < n_params < 27e6, n_params
+
+
 def test_mobilenet_forward():
     net = vision.get_model("mobilenet0.25", classes=10)
     net.initialize(init=mx.init.Xavier())
