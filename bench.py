@@ -423,9 +423,16 @@ def bench_bert(cfg, devices):
     # scan_layers: the 12-layer trunk compiles as ONE scanned layer —
     # without it the whole-step AOT compile through the tunnel takes
     # tens of minutes and blows the worker budget
+    from mxnet_tpu.ops.pallas_attention import _LANE, _use_interpret
+
+    attn_req = cfg.get("attn", "dense")
+    attn_used = attn_req
+    if attn_req == "flash" and not _use_interpret() \
+            and seq_len % _LANE != 0:
+        attn_used = "dense"
     net = bert_zoo.bert_base(dropout=0.0, max_length=seq_len,
                              scan_layers=True,
-                             attention_impl=cfg.get("attn", "dense"))
+                             attention_impl=attn_req)
     net.initialize(init=mx.init.Xavier())
     net.cast("bfloat16")
 
@@ -477,7 +484,10 @@ def bench_bert(cfg, devices):
         "backend": devices[0].platform,
         "batch": batch_size,
         "seq": seq_len,
-        "attn": cfg.get("attn", "dense"),
+        # the path that actually RAN, not the one requested:
+        # flash_attention silently dispatches dense when T is not
+        # lane-aligned on TPU (ops/pallas_attention.py)
+        "attn": attn_used,
         "scan_layers": True,
     }))
 

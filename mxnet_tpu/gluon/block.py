@@ -481,17 +481,12 @@ class HybridBlock(Block):
             call_args = jtu.tree_unflatten(args_tree, flat)
             pm = {pid: val for pid, val in
                   zip(self._param_order_ids, param_vals)}
-            prev_map, prev_aux = _TRACE.param_map, _TRACE.aux_collector
-            _TRACE.param_map = pm
-            _TRACE.aux_collector = {}
-            try:
-                with _random.key_scope(key), \
-                        (_ag.train_mode() if training
-                         else _ag.predict_mode()):
-                    out = self.forward(*call_args)
-                aux = _TRACE.aux_collector
-            finally:
-                _TRACE.param_map, _TRACE.aux_collector = prev_map, prev_aux
+            aux = {}
+            with param_override_scope(pm, aux), \
+                    _random.key_scope(key), \
+                    (_ag.train_mode() if training
+                     else _ag.predict_mode()):
+                out = self.forward(*call_args)
             return out, aux
 
         # hybridize(remat=...) / MXNET_BACKWARD_DO_MIRROR: backward
@@ -601,6 +596,27 @@ class HybridBlock(Block):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def param_override_scope(param_map, collected):
+    """Run a block functionally: Parameters whose id() is in
+    ``param_map`` read the mapped value instead of their stored data,
+    and aux updates recorded via :func:`record_aux_update` land in the
+    ``collected`` dict (keyed by param name).  The ONE home of the
+    save/set/restore protocol — the whole-block jit path, the sharded
+    trainer, and the pipeline trainer all enter through here.
+    """
+    prev_map, prev_aux = _TRACE.param_map, _TRACE.aux_collector
+    _TRACE.param_map = param_map
+    _TRACE.aux_collector = collected
+    try:
+        yield
+    finally:
+        _TRACE.param_map, _TRACE.aux_collector = prev_map, prev_aux
 
 
 def record_aux_update(param_name, raw_value):

@@ -268,22 +268,17 @@ class ShardedTrainer:
 
         def pure_step(param_vals, opt_state, aux_vals, x, y, key, lr, t):
             def loss_of(pv, aux_cur, xb, yb, kb):
+                from ..gluon.block import param_override_scope
+
                 pm = dict(zip(t_ids, pv))
                 pm.update({i: aux_cur[n]
                            for i, n in zip(a_ids, a_names)})
-                prev_map = _TRACE.param_map
-                prev_aux = _TRACE.aux_collector
-                _TRACE.param_map = pm
-                _TRACE.aux_collector = {}
-                try:
-                    with _random.key_scope(kb), _ag.train_mode():
-                        out = block.forward(xb)
-                        loss = loss_block(out, yb) \
-                            if loss_block is not None else out
-                    aux_upd = _TRACE.aux_collector
-                finally:
-                    _TRACE.param_map = prev_map
-                    _TRACE.aux_collector = prev_aux
+                aux_upd = {}
+                with param_override_scope(pm, aux_upd), \
+                        _random.key_scope(kb), _ag.train_mode():
+                    out = block.forward(xb)
+                    loss = loss_block(out, yb) \
+                        if loss_block is not None else out
                 return jnp.mean(loss), aux_upd
 
             # remat='full'|'dots'|... or MXNET_BACKWARD_DO_MIRROR: the
