@@ -1,0 +1,87 @@
+"""Sparse linear classification on LibSVM data (reference:
+example/sparse/linear_classification/train.py).
+
+The end-to-end CSR path (VERDICT r3 task #5): LibSVMIter yields CSR
+batches; the logistic-regression forward is ``nd.sparse.dot(csr, W)`` —
+the compact gather/segment-sum kernel, O(nnz·D) compute with no dense
+(batch, dim) view — and the backward is the compact transpose kernel
+(``dot(csrᵀ, dy)``), so a high-dimensional sparse dataset trains
+without ever materializing dense feature matrices.
+
+Run: python examples/linear_classification_libsvm.py [--dim 10000]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, io, nd
+
+
+def make_libsvm(path, n_rows, dim, nnz_per_row, rs):
+    """Synthetic separable-ish problem: y = sign(w_true · x)."""
+    w_true = rs.standard_normal(dim).astype(np.float32)
+    with open(path, "w") as f:
+        for _ in range(n_rows):
+            cols = np.sort(rs.choice(dim, size=nnz_per_row,
+                                     replace=False))
+            vals = rs.standard_normal(nnz_per_row).astype(np.float32)
+            y = 1.0 if float(vals @ w_true[cols]) > 0 else 0.0
+            feats = " ".join(f"{c}:{v:.4f}" for c, v in zip(cols, vals))
+            f.write(f"{y:.0f} {feats}\n")
+
+
+def main(dim=10000, n_rows=512, batch_size=64, epochs=10, lr=1.0,
+         seed=0):
+    rs = np.random.RandomState(seed)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "train.libsvm")
+        make_libsvm(path, n_rows, dim, nnz_per_row=16, rs=rs)
+
+        train = io.LibSVMIter(data_libsvm=path, data_shape=(dim,),
+                              batch_size=batch_size)
+        w = nd.zeros((dim, 1))
+        b = nd.zeros((1,))
+        w.attach_grad()
+        b.attach_grad()
+
+        acc = 0.0
+        for epoch in range(epochs):
+            train.reset()
+            correct = total = 0
+            for batch in train:
+                x_csr, y = batch.data[0], batch.label[0]
+                yv = y.asnumpy().reshape(-1, 1)
+                with autograd.record():
+                    # compact kernel: no dense (batch, dim) view
+                    logits = nd.sparse.dot(x_csr, w) + b
+                    loss = nd.mean(
+                        nd.relu(logits) - logits * nd.array(yv) +
+                        nd.log(1 + nd.exp(-nd.abs(logits))))
+                loss.backward()
+                for p in (w, b):
+                    p._set_data(p._data - lr * p.grad._data)
+                    p.grad._set_data(p.grad._data * 0)
+                pred = (logits.asnumpy() > 0).astype(np.float32)
+                correct += int((pred == yv).sum())
+                total += len(yv)
+            acc = correct / total
+            print(f"epoch {epoch}: train accuracy {acc:.3f}")
+        assert acc > 0.9, f"sparse linear model failed to fit ({acc})"
+        print(f"final accuracy {acc:.3f} (dim={dim}, "
+              f"nnz/row=16 — dense view never built)")
+        return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=10000)
+    ap.add_argument("--epochs", type=int, default=5)
+    args = ap.parse_args()
+    main(dim=args.dim, epochs=args.epochs)

@@ -723,35 +723,82 @@ class ImageRecordIter(DataIter):
 
 
 class LibSVMIter(DataIter):
-    """LibSVM sparse text format reader (reference: src/io/iter_libsvm.cc);
-    rows densify on load (XLA has no sparse layout)."""
+    """LibSVM sparse text format reader (reference: src/io/iter_libsvm.cc).
+
+    Batches carry CSR data (the reference's behavior) so a linear model
+    can run the compact ``sparse.dot`` kernels without ever
+    materializing the (batch, dim) dense view; pass ``stype="default"``
+    for dense batches (the pre-round-4 behavior)."""
 
     def __init__(self, data_libsvm, data_shape, label_shape=None,
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, stype="csr", **kwargs):
         super().__init__(batch_size)
         dim = data_shape[0] if isinstance(data_shape, (tuple, list)) \
             else data_shape
-        rows, labels = [], []
+        self._dim = dim
+        self._stype = stype
+        vals, cols, indptr, labels = [], [], [0], []
         with open(data_libsvm) as f:
             for line in f:
                 parts = line.strip().split()
                 if not parts:
                     continue
                 labels.append(float(parts[0]))
-                row = _np.zeros(dim, dtype=_np.float32)
                 for kv in parts[1:]:
                     k, v = kv.split(":")
-                    row[int(k)] = float(v)
-                rows.append(row)
-        self._iter = NDArrayIter(
-            _np.stack(rows), _np.asarray(labels, dtype=_np.float32),
-            batch_size,
-            last_batch_handle="roll_over" if round_batch else "pad")
-        self.provide_data = self._iter.provide_data
-        self.provide_label = self._iter.provide_label
+                    cols.append(int(k))
+                    vals.append(float(v))
+                indptr.append(len(vals))
+        self._vals = _np.asarray(vals, _np.float32)
+        self._cols = _np.asarray(cols, _np.int32)
+        self._indptr = _np.asarray(indptr, _np.int64)
+        self._counts = _np.diff(self._indptr)  # once, not per batch
+        self._labels = _np.asarray(labels, _np.float32)
+        self._n = len(labels)
+        self._round = round_batch
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size, dim))]
+        lshape = label_shape or (1,)
+        if not isinstance(lshape, (tuple, list)):
+            lshape = (lshape,)
+        self.provide_label = [DataDesc(
+            "softmax_label", (batch_size,) + tuple(
+                s for s in lshape if s != 1))]
 
     def reset(self):
-        self._iter.reset()
+        self._cursor = 0
+
+    def _rows_csr(self, idx):
+        """CSR slice of the given row ids as a CSRNDArray."""
+        from ..ndarray.sparse import CSRNDArray
+
+        counts = self._counts[idx]
+        starts = self._indptr[idx]
+        take = _np.concatenate(
+            [_np.arange(s, s + c) for s, c in zip(starts, counts)]) \
+            if len(idx) else _np.zeros((0,), _np.int64)
+        indptr = _np.concatenate(
+            [[0], _np.cumsum(counts)]).astype(_np.int32)
+        return CSRNDArray(self._vals[take], self._cols[take], indptr,
+                          (len(idx), self._dim))
 
     def next(self):
-        return self._iter.next()
+        if self._cursor >= self._n:
+            raise StopIteration
+        lo = self._cursor
+        hi = lo + self.batch_size
+        pad = 0
+        if hi > self._n and not self._round:
+            pad = hi - self._n
+        self._cursor = hi
+        idx = _np.arange(lo, hi) % self._n
+        label = _array(self._labels[idx])
+        if self._stype == "csr":
+            data = self._rows_csr(idx)
+        else:
+            dense = _np.zeros((len(idx), self._dim), _np.float32)
+            for r, i in enumerate(idx):
+                s, e = self._indptr[i], self._indptr[i + 1]
+                dense[r, self._cols[s:e]] = self._vals[s:e]
+            data = _array(dense)
+        return DataBatch([data], [label], pad=pad)

@@ -147,3 +147,8 @@ from . import random  # noqa: E402
 from . import linalg  # noqa: E402
 from . import contrib  # noqa: E402
 from . import sparse  # noqa: E402
+
+# storage-type dispatch for dot/cast_storage lives at the invoke layer
+# (ndarray/register.py _stype_dispatch, the FComputeEx analog), so EVERY
+# entry point — nd.dot, NDArray.__matmul__, invoke_registered — routes a
+# CSR lhs to the compact kernels instead of densifying at unwrap.

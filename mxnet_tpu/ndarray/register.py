@@ -57,9 +57,38 @@ def _inject(opdef: OpDef, kwargs: dict) -> dict:
     return kwargs
 
 
+# FComputeEx analog (reference: storage-type dispatch in
+# imperative_utils.h): ops with a compact sparse kernel route there
+# BEFORE the generic unwrap densifies the sparse operand.  Keyed by op
+# name; the handler receives (args, kwargs) with NDArrays intact.
+def _stype_dispatch(opdef, args, kwargs):
+    if not args or not isinstance(args[0], NDArray):
+        return None
+    if opdef.name == "dot":
+        from .sparse import CSRNDArray
+        from .sparse import dot as sparse_dot
+
+        if isinstance(args[0], CSRNDArray):
+            return sparse_dot(args[0], args[1],
+                              transpose_a=kwargs.get("transpose_a",
+                                                     False),
+                              transpose_b=kwargs.get("transpose_b",
+                                                     False))
+    elif opdef.name.lower() == "cast_storage":
+        from .sparse import cast_storage as sparse_cast
+
+        stype = kwargs.get("stype", args[1] if len(args) > 1
+                           else "default")
+        return sparse_cast(args[0], stype)
+    return None
+
+
 def invoke(opdef: OpDef, args: tuple, kwargs: dict):
     # frontend-only kwargs accepted by every reference op wrapper
     out_arr = kwargs.pop("out", None)
+    sparse_out = _stype_dispatch(opdef, args, kwargs)
+    if sparse_out is not None:
+        return sparse_out
     req_ctx = kwargs.pop("ctx", None)
     name = kwargs.pop("name", None)  # symbol-compat: ignored eagerly
     kwargs = _inject(opdef, kwargs)

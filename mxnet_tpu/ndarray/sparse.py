@@ -327,10 +327,116 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         n_cols = shape[1] if shape else int(indices.max()) + 1
         return CSRNDArray(data.astype(dtype or data.dtype), indices,
                           indptr, (n_rows, n_cols), ctx)
-    a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)(),
-                    dtype=dtype or "float32")
+    a = _np.asarray(getattr(arg1, "asnumpy", lambda: arg1)())
+    # preserve the input dtype (reference cast_storage round-trips any
+    # dtype); only force float32 for dtype-less python lists
+    a = a.astype(dtype or (a.dtype if a.dtype != _np.object_
+                           else "float32"))
     data, indices, indptr = _sparsify_csr(a)
     return CSRNDArray(data, indices, indptr, a.shape, ctx)
+
+
+# -- sparse COMPUTE (VERDICT r3 task #5) ---------------------------------------
+#
+# dot(csr, dense) and dot(csrᵀ, dense) as jit-able gather + segment-sum /
+# scatter-add — the TPU formulation of the reference's CSR kernels
+# (src/operator/tensor/dot.cc DotCsrDnsDns / DotCsrTransDnsDns): no
+# (rows × cols) dense view of the sparse matrix is ever materialized;
+# compute is O(nnz · D).
+
+
+def _csr_rows_of(indptr, nnz):
+    """jit-able (nnz,) row id per stored value: row r owns positions
+    indptr[r] <= p < indptr[r+1]."""
+    import jax.numpy as jnp
+
+    return (jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1) \
+        .astype(jnp.int32)
+
+
+def csr_dot_dense(data, indices, indptr, rhs, out_rows,
+                  transpose_a=False):
+    """Pure-function CSR @ dense (jit-able, static nnz).
+
+    data (nnz,), indices (nnz,), indptr (rows+1,), rhs 2-D.
+    transpose_a=False: (rows, C) @ (C, D) -> (rows, D), out_rows=rows.
+    transpose_a=True:  (rows, C)ᵀ @ (rows, D) -> (C, D), out_rows=C.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nnz = data.shape[0]
+    rows = _csr_rows_of(indptr, nnz)
+    if transpose_a:
+        contrib = data[:, None] * rhs[rows]              # (nnz, D)
+        out = jnp.zeros((out_rows, rhs.shape[1]), contrib.dtype)
+        return out.at[indices].add(contrib)
+    gathered = data[:, None] * jnp.take(rhs, indices, axis=0)
+    return jax.ops.segment_sum(gathered, rows, num_segments=out_rows)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: mx.nd.sparse.dot over
+    src/operator/tensor/dot.cc).  CSR lhs runs the compact kernels
+    above; the backward is compact too (dRhs = csrᵀ @ dy — never a
+    dense view of lhs).  Dense lhs falls through to the dense op."""
+    from .register import invoke_registered
+
+    if not isinstance(lhs, CSRNDArray):
+        return invoke_registered(
+            "dot", (lhs, rhs),
+            {"transpose_a": transpose_a, "transpose_b": transpose_b})
+    if transpose_b:
+        raise MXNetError("sparse.dot: transpose_b unsupported for CSR "
+                         "lhs (reference parity: dot.cc has no "
+                         "CsrDns^T kernel)")
+    from .. import autograd as _ag
+
+    n_rows, n_cols = lhs._logical_shape
+    need = n_rows if transpose_a else n_cols
+    if rhs.shape[0] != need:
+        # explicit check: a wrong rhs would otherwise gather/scatter
+        # out-of-bounds, which jax CLAMPS instead of raising
+        raise MXNetError(
+            f"sparse.dot: shape mismatch {lhs._logical_shape}"
+            f"{'ᵀ' if transpose_a else ''} @ {tuple(rhs.shape)}")
+    out_rows = n_cols if transpose_a else n_rows
+
+    class _Fn(_ag.Function):
+        def forward(self, lhs_, rhs_):
+            self._parts = (lhs_._csr_data, lhs_._csr_indices,
+                           lhs_._csr_indptr)
+            y = csr_dot_dense(*self._parts, rhs_._data, out_rows,
+                              transpose_a)
+            return _from_jax(y)
+
+        def backward(self, g):
+            # dRhs: flip the transpose — still a compact kernel
+            drhs = csr_dot_dense(
+                *self._parts, g._data,
+                n_rows if transpose_a else n_cols,
+                not transpose_a)
+            return None, _from_jax(drhs)
+
+    return _Fn()(lhs, rhs)
+
+
+def cast_storage(arr, stype):
+    """Real storage casting at the NDArray level (reference:
+    mx.nd.cast_storage, src/operator/tensor/cast_storage.cc): produces
+    actual compact CSR/RowSparse arrays, not a dense tagged view."""
+    if stype == "default":
+        return arr.tostype("default") if isinstance(
+            arr, BaseSparseNDArray) else arr
+    if stype == "csr":
+        if isinstance(arr, CSRNDArray):
+            return arr
+        return csr_matrix(arr)
+    if stype == "row_sparse":
+        if isinstance(arr, RowSparseNDArray):
+            return arr
+        return row_sparse_array(arr)
+    raise MXNetError(f"cast_storage: unknown stype {stype!r}")
 
 
 def zeros(stype, shape, ctx=None, dtype=None):

@@ -62,3 +62,9 @@ def test_example_lstm_language_model():
     out = _run("lstm_language_model.py", "--epochs", "3", "--tokens",
                "2000", "--vocab", "50")
     assert "lstm_language_model OK" in out
+
+
+def test_example_sparse_linear_libsvm():
+    out = _run("linear_classification_libsvm.py", "--dim", "2000",
+               "--epochs", "10")
+    assert "final accuracy" in out

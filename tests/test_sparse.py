@@ -247,3 +247,140 @@ def test_grad_req_add_accumulates_sparse():
     dense = g.asnumpy()
     np.testing.assert_allclose(dense[2], 2 * np.ones(4), rtol=1e-6)
     np.testing.assert_allclose(dense[1], np.ones(4), rtol=1e-6)
+
+
+# -- sparse COMPUTE (VERDICT r3 task #5) ---------------------------------------
+
+def test_csr_dot_dense_matches_oracle():
+    """dot(csr, dense) and dot(csrᵀ, dense) against numpy, fwd + the
+    compact rhs gradient."""
+    rs = np.random.RandomState(0)
+    a = (rs.rand(8, 12) < 0.3) * rs.standard_normal((8, 12))
+    a = a.astype(np.float32)
+    w = rs.standard_normal((12, 5)).astype(np.float32)
+    a_csr = csr_matrix(a)
+    w_nd = nd.array(w)
+    w_nd.attach_grad()
+
+    with autograd.record():
+        y = nd.sparse.dot(a_csr, w_nd)
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), a @ w, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(w_nd.grad.asnumpy(), a.T @ (2 * (a @ w)),
+                               rtol=1e-4, atol=1e-4)
+
+    # transpose_a: (8, 12)ᵀ @ (8, 5) -> (12, 5)
+    x = rs.standard_normal((8, 5)).astype(np.float32)
+    x_nd = nd.array(x)
+    x_nd.attach_grad()
+    with autograd.record():
+        yt = nd.sparse.dot(a_csr, x_nd, transpose_a=True)
+        loss = (yt * yt).sum()
+    loss.backward()
+    np.testing.assert_allclose(yt.asnumpy(), a.T @ x, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(x_nd.grad.asnumpy(),
+                               a @ (2 * (a.T @ x)), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_csr_dot_dense_is_jittable():
+    """The kernel itself is pure and static-shaped: jit compiles it and
+    the jitted result matches (the reference's DotCsrDnsDns under jit —
+    no dense (rows, cols) intermediate; the HLO has no such tensor)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.sparse import csr_dot_dense
+
+    rs = np.random.RandomState(1)
+    a = ((rs.rand(16, 300) < 0.1) *
+         rs.standard_normal((16, 300))).astype(np.float32)
+    w = rs.standard_normal((300, 7)).astype(np.float32)
+    a_csr = csr_matrix(a)
+    f = jax.jit(lambda d, i, p, r: csr_dot_dense(d, i, p, r, 16))
+    out = f(a_csr._csr_data, a_csr._csr_indices, a_csr._csr_indptr,
+            jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(out), a @ w, rtol=1e-4,
+                               atol=1e-4)
+    txt = jax.jit(
+        lambda d, i, p, r: csr_dot_dense(d, i, p, r, 16)).lower(
+        a_csr._csr_data, a_csr._csr_indices, a_csr._csr_indptr,
+        jnp.asarray(w)).as_text()
+    assert "16x300" not in txt  # never materializes the dense view
+
+
+def test_cast_storage_real():
+    rs = np.random.RandomState(2)
+    dense = ((rs.rand(20, 6) < 0.2) *
+             rs.standard_normal((20, 6))).astype(np.float32)
+    d_nd = nd.array(dense)
+    as_csr = nd.cast_storage(d_nd, "csr")
+    assert isinstance(as_csr, CSRNDArray)
+    np.testing.assert_allclose(as_csr.asnumpy(), dense)
+    as_rs = nd.cast_storage(d_nd, "row_sparse")
+    assert isinstance(as_rs, RowSparseNDArray)
+    assert as_rs.num_stored_rows == int((dense != 0).any(1).sum())
+    back = nd.cast_storage(as_csr, "default")
+    assert not isinstance(back, CSRNDArray)
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_libsvm_iter_yields_csr():
+    import os
+    import tempfile
+
+    from mxnet_tpu import io
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "t.libsvm")
+        with open(path, "w") as f:
+            f.write("1 0:1.5 3:2.0\n0 1:-1.0\n1 2:0.5 3:1.0\n")
+        it = io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                           batch_size=2)
+        batch = next(it)
+        x = batch.data[0]
+        assert isinstance(x, CSRNDArray)
+        np.testing.assert_allclose(
+            x.asnumpy(), [[1.5, 0, 0, 2.0], [0, -1.0, 0, 0]])
+        np.testing.assert_allclose(batch.label[0].asnumpy(), [1, 0])
+        batch2 = next(it)  # round_batch wraps
+        assert batch2.data[0].shape == (2, 4)
+        # dense mode preserved for compat
+        it_d = io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                             batch_size=2, stype="default")
+        xd = next(it_d).data[0]
+        assert not isinstance(xd, CSRNDArray)
+        np.testing.assert_allclose(
+            xd.asnumpy(), [[1.5, 0, 0, 2.0], [0, -1.0, 0, 0]])
+
+
+def test_csr_dot_dispatch_covers_all_entry_points():
+    """The stype dispatch lives at the invoke layer: nd.dot, the @
+    operator, and invoke_registered all route a CSR lhs to the compact
+    kernel (never the densify-at-unwrap path)."""
+    rs = np.random.RandomState(4)
+    a = ((rs.rand(6, 9) < 0.4) *
+         rs.standard_normal((6, 9))).astype(np.float32)
+    w = rs.standard_normal((9, 3)).astype(np.float32)
+    a_csr = csr_matrix(a)
+    w_nd = nd.array(w)
+    expect = a @ w
+    np.testing.assert_allclose(nd.dot(a_csr, w_nd).asnumpy(), expect,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose((a_csr @ w_nd).asnumpy(), expect,
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(Exception, match="shape mismatch"):
+        nd.sparse.dot(a_csr, nd.array(w[:5]))
+
+
+def test_cast_storage_preserves_dtype():
+    # int32 survives jnp.asarray (f64 would be downcast at nd.array
+    # already, before cast_storage is involved); nd.array defaults to
+    # f32 (reference semantics) so pass dtype explicitly
+    x = nd.array(np.arange(6).reshape(2, 3), dtype="int32")
+    back = nd.cast_storage(nd.cast_storage(x, "csr"), "default")
+    assert back.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(back.asnumpy(),
+                                  np.arange(6).reshape(2, 3))
