@@ -1,0 +1,211 @@
+/*
+ * AI::MXNetTPU — Perl XS shim over the flat C ABI (src/mxtpu_c_api.h).
+ *
+ * Reference parity: perl-package/ (AI::MXNet) binds the reference
+ * through c_api.h the same way; this is the identical contract over
+ * libmxtpu.so.  The XS layer is deliberately thin — handles cross as
+ * IVs, tensor data as packed byte strings — and everything typed lives
+ * in generated Perl (lib/AI/MXNetTPU/Ops.pm).
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu_c_api.h"
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU    PREFIX = xs_
+
+PROTOTYPES: DISABLE
+
+int
+xs_init_runtime()
+    CODE:
+        RETVAL = MXTPUInit();
+    OUTPUT:
+        RETVAL
+
+void
+xs_shutdown_runtime()
+    CODE:
+        MXTPUShutdown();
+
+const char *
+xs_last_error()
+    CODE:
+        RETVAL = MXGetLastError();
+    OUTPUT:
+        RETVAL
+
+IV
+xs_ndarray_create(SV *databuf, AV *shape, const char *dtype)
+    PREINIT:
+        STRLEN len;
+        const char *buf;
+        int ndim, i;
+        int64_t cshape[8];
+        NDArrayHandle h = NULL;
+    CODE:
+        buf = SvPV(databuf, len);
+        ndim = av_len(shape) + 1;
+        if (ndim > 8)
+            croak("ndarray_create: ndim %d > 8", ndim);
+        for (i = 0; i < ndim; ++i)
+            cshape[i] = (int64_t)SvIV(*av_fetch(shape, i, 0));
+        if (MXNDArrayCreate(buf, (size_t)len, cshape, ndim, dtype, &h))
+            croak("MXNDArrayCreate: %s", MXGetLastError());
+        RETVAL = PTR2IV(h);
+    OUTPUT:
+        RETVAL
+
+void
+xs_ndarray_free(IV h)
+    CODE:
+        MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+xs_ndarray_shape(IV h)
+    PREINIT:
+        int ndim, i;
+        int64_t shape[8];
+    PPCODE:
+        if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, shape))
+            croak("MXNDArrayGetShape: %s", MXGetLastError());
+        EXTEND(SP, ndim);
+        for (i = 0; i < ndim; ++i)
+            PUSHs(sv_2mortal(newSViv((IV)shape[i])));
+
+SV *
+xs_ndarray_to_bytes(IV h)
+    PREINIT:
+        size_t nbytes;
+        NDArrayHandle nd;
+        SV *out;
+        char *p;
+    CODE:
+        nd = INT2PTR(NDArrayHandle, h);
+        if (MXNDArraySize(nd, &nbytes))
+            croak("MXNDArraySize: %s", MXGetLastError());
+        out = newSV(nbytes ? nbytes : 1);
+        SvPOK_on(out);
+        p = SvPVX(out);
+        if (MXNDArraySyncCopyToCPU(nd, p, nbytes))
+            croak("MXNDArraySyncCopyToCPU: %s", MXGetLastError());
+        SvCUR_set(out, nbytes);
+        RETVAL = out;
+    OUTPUT:
+        RETVAL
+
+void
+xs_invoke_raw(const char *op, AV *inputs, AV *pkeys, AV *pvals)
+    PREINIT:
+        NDArrayHandle ins[32];
+        NDArrayHandle outs[8];
+        const char *keys[32];
+        const char *vals[32];
+        int n_in, n_params, n_out, i;
+    PPCODE:
+        n_in = av_len(inputs) + 1;
+        n_params = av_len(pkeys) + 1;
+        if (n_in > 32 || n_params > 32)
+            croak("invoke: too many inputs/params");
+        for (i = 0; i < n_in; ++i)
+            ins[i] = INT2PTR(NDArrayHandle,
+                             SvIV(*av_fetch(inputs, i, 0)));
+        for (i = 0; i < n_params; ++i) {
+            keys[i] = SvPV_nolen(*av_fetch(pkeys, i, 0));
+            vals[i] = SvPV_nolen(*av_fetch(pvals, i, 0));
+        }
+        n_out = 8;
+        if (MXImperativeInvoke(op, ins, n_in, keys, vals, n_params,
+                               outs, &n_out))
+            croak("MXImperativeInvoke(%s): %s", op, MXGetLastError());
+        EXTEND(SP, n_out);
+        for (i = 0; i < n_out; ++i)
+            PUSHs(sv_2mortal(newSViv(PTR2IV(outs[i]))));
+
+void
+xs_list_ops_raw()
+    PREINIT:
+        int count, i;
+        const char **names;
+    PPCODE:
+        if (MXListAllOpNames(&count, &names))
+            croak("MXListAllOpNames: %s", MXGetLastError());
+        EXTEND(SP, count);
+        for (i = 0; i < count; ++i)
+            PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+
+void
+xs_attach_grad(IV h)
+    CODE:
+        if (MXAutogradAttachGrad(INT2PTR(NDArrayHandle, h)))
+            croak("MXAutogradAttachGrad: %s", MXGetLastError());
+
+void
+xs_record_start()
+    CODE:
+        if (MXAutogradRecordStart())
+            croak("MXAutogradRecordStart: %s", MXGetLastError());
+
+void
+xs_record_stop()
+    CODE:
+        if (MXAutogradRecordStop())
+            croak("MXAutogradRecordStop: %s", MXGetLastError());
+
+void
+xs_backward(IV loss)
+    CODE:
+        if (MXAutogradBackward(INT2PTR(NDArrayHandle, loss)))
+            croak("MXAutogradBackward: %s", MXGetLastError());
+
+IV
+xs_get_grad(IV h)
+    PREINIT:
+        NDArrayHandle g = NULL;
+    CODE:
+        if (MXNDArrayGetGrad(INT2PTR(NDArrayHandle, h), &g))
+            croak("MXNDArrayGetGrad: %s", MXGetLastError());
+        RETVAL = PTR2IV(g);
+    OUTPUT:
+        RETVAL
+
+int
+xs_kvstore_create(const char *type)
+    PREINIT:
+        KVStoreHandle kv;
+    CODE:
+        if (MXKVStoreCreate(type, &kv))
+            croak("MXKVStoreCreate: %s", MXGetLastError());
+        RETVAL = kv;
+    OUTPUT:
+        RETVAL
+
+void
+xs_kvstore_init(int kv, int key, IV v)
+    CODE:
+        if (MXKVStoreInit(kv, key, INT2PTR(NDArrayHandle, v)))
+            croak("MXKVStoreInit: %s", MXGetLastError());
+
+void
+xs_kvstore_push(int kv, int key, IV v)
+    CODE:
+        if (MXKVStorePush(kv, key, INT2PTR(NDArrayHandle, v)))
+            croak("MXKVStorePush: %s", MXGetLastError());
+
+void
+xs_kvstore_free(int kv)
+    CODE:
+        MXKVStoreFree(kv);
+
+IV
+xs_kvstore_pull(int kv, int key)
+    PREINIT:
+        NDArrayHandle out = NULL;
+    CODE:
+        if (MXKVStorePull(kv, key, &out))
+            croak("MXKVStorePull: %s", MXGetLastError());
+        RETVAL = PTR2IV(out);
+    OUTPUT:
+        RETVAL

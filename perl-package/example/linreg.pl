@@ -1,0 +1,62 @@
+#!/usr/bin/perl
+# Train 1D linear regression through the Perl binding ONLY — no Python
+# source in this program (reference analog: perl-package/AI-MXNet
+# examples driving c_api.h; mirrors cpp-package/example/linreg.cpp).
+#
+# Run (after building the XS module):
+#   cd perl-package && perl Makefile.PL && make
+#   PYTHONPATH=$repo PERL5LIB=blib/lib:blib/arch perl example/linreg.pl
+use strict;
+use warnings;
+
+use AI::MXNetTPU;
+use AI::MXNetTPU::Ops;
+
+# y = 3x - 1
+my (@xs, @ys);
+for my $i (0 .. 31) {
+    my $x = $i / 8.0 - 2.0;
+    push @xs, $x;
+    push @ys, 3.0 * $x - 1.0;
+}
+my $x = AI::MXNetTPU::NDArray->new(\@xs, [32, 1]);
+my $y = AI::MXNetTPU::NDArray->new(\@ys, [32, 1]);
+my $w = AI::MXNetTPU::NDArray->new([0.0], [1, 1]);
+my $b = AI::MXNetTPU::NDArray->new([0.0], [1]);
+$w->attach_grad;
+$b->attach_grad;
+
+my $lr = 0.2;
+for my $step (0 .. 59) {
+    my $loss;
+    {
+        my $rec  = AI::MXNetTPU::AutogradRecord->new;
+        # generated typed wrappers (Ops.pm) and the generic invoke
+        # surface compose freely (varargs ops like broadcast_add keep
+        # the generic spelling, as in cpp-package)
+        my ($wx) = AI::MXNetTPU::Ops::dot($x, $w);
+        my ($pred) = AI::MXNetTPU::invoke('broadcast_add', [$wx, $b]);
+        my ($diff) = AI::MXNetTPU::invoke('broadcast_sub', [$pred, $y]);
+        my ($sq)   = AI::MXNetTPU::Ops::square($diff);
+        ($loss) = AI::MXNetTPU::Ops::mean($sq);
+    }
+    $loss->backward;
+    # fused optimizer op through the same C surface
+    my ($w2) = AI::MXNetTPU::invoke('sgd_update', [$w, $w->grad],
+                                    { lr => $lr });
+    my ($b2) = AI::MXNetTPU::invoke('sgd_update', [$b, $b->grad],
+                                    { lr => $lr });
+    $w = $w2;
+    $b = $b2;
+    $w->attach_grad;
+    $b->attach_grad;
+}
+
+my $wf = $w->aslist->[0];
+my $bf = $b->aslist->[0];
+printf("w=%.4f b=%.4f\n", $wf, $bf);
+if (abs($wf - 3.0) > 0.05 || abs($bf + 1.0) > 0.05) {
+    print "FAIL\n";
+    exit 1;
+}
+print "PASS\n";

@@ -1,0 +1,144 @@
+package AI::MXNetTPU;
+
+# Perl frontend for the mxnet_tpu framework (reference parity:
+# perl-package/AI-MXNet binding the reference through c_api.h).
+# Everything below drives libmxtpu.so — no Python source in the
+# caller's program; the embedded interpreter inside the library is an
+# implementation detail of the C ABI (see src/c_api.cc header).
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+# DynaLoader with RTLD_GLOBAL (0x01), NOT XSLoader: the shim links
+# libpython, and numpy/jax C extensions loaded later by the embedded
+# interpreter resolve Python symbols from the GLOBAL namespace — a
+# default RTLD_LOCAL dlopen leaves them unresolvable ("Error importing
+# numpy..." at MXTPUInit).
+use DynaLoader ();
+our @ISA = ('DynaLoader');
+sub dl_load_flags { 0x01 }
+__PACKAGE__->bootstrap($VERSION);
+
+my $booted = 0;
+
+sub ensure_init {
+    return if $booted;
+    init_runtime() == 0 or die "MXTPUInit failed: " . last_error();
+    $booted = 1;
+}
+
+# invoke a registered op: (name, \@NDArray_inputs, \%params) -> list of
+# NDArrays (the generic builder; typed wrappers in AI::MXNetTPU::Ops)
+sub invoke {
+    my ($op, $inputs, $params) = @_;
+    ensure_init();
+    $params ||= {};
+    my @in_h = map { $_->{handle} } @$inputs;
+    my @keys = sort keys %$params;
+    my @vals = map { "" . $params->{$_} } @keys;
+    my @out  = invoke_raw($op, \@in_h, \@keys, \@vals);
+    return map { AI::MXNetTPU::NDArray->_from_handle($_) } @out;
+}
+
+sub list_ops {
+    ensure_init();
+    return list_ops_raw();
+}
+
+package AI::MXNetTPU::AutogradRecord;
+
+sub new {
+    my ($class) = @_;
+    AI::MXNetTPU::ensure_init();
+    AI::MXNetTPU::record_start();
+    return bless {}, $class;
+}
+
+sub DESTROY { AI::MXNetTPU::record_stop() }
+
+package AI::MXNetTPU::NDArray;
+
+# float32 NDArray over an opaque C handle.  Data crosses the boundary
+# as pack("f*")-ed byte strings.
+
+sub new {
+    my ($class, $data, $shape) = @_;
+    AI::MXNetTPU::ensure_init();
+    my $buf = pack("f*", @$data);
+    my $h = AI::MXNetTPU::ndarray_create($buf, $shape, "float32");
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+sub _from_handle {
+    my ($class, $h) = @_;
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+sub shape { [ AI::MXNetTPU::ndarray_shape($_[0]{handle}) ] }
+
+sub aslist {
+    my ($self) = @_;
+    return [ unpack("f*",
+                    AI::MXNetTPU::ndarray_to_bytes($self->{handle})) ];
+}
+
+sub attach_grad { AI::MXNetTPU::attach_grad($_[0]{handle}); $_[0] }
+
+sub grad {
+    my ($self) = @_;
+    return AI::MXNetTPU::NDArray->_from_handle(
+        AI::MXNetTPU::get_grad($self->{handle}));
+}
+
+sub backward { AI::MXNetTPU::backward($_[0]{handle}) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::ndarray_free($self->{handle})
+        if $self->{owned} && $self->{handle};
+    $self->{handle} = 0;
+}
+
+package AI::MXNetTPU::KVStore;
+
+sub new {
+    my ($class, $type) = @_;
+    AI::MXNetTPU::ensure_init();
+    return bless { kv => AI::MXNetTPU::kvstore_create($type || "local") },
+        $class;
+}
+
+sub init { AI::MXNetTPU::kvstore_init($_[0]{kv}, $_[1], $_[2]{handle}) }
+sub push_ { AI::MXNetTPU::kvstore_push($_[0]{kv}, $_[1], $_[2]{handle}) }
+
+sub pull {
+    my ($self, $key) = @_;
+    return AI::MXNetTPU::NDArray->_from_handle(
+        AI::MXNetTPU::kvstore_pull($self->{kv}, $key));
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::kvstore_free($self->{kv}) if defined $self->{kv};
+    delete $self->{kv};
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl binding for the mxnet_tpu framework over its C ABI
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  use AI::MXNetTPU::Ops;   # generated typed op wrappers
+
+  my $x = AI::MXNetTPU::NDArray->new([1, 2, 3], [3]);
+  my ($y) = AI::MXNetTPU::Ops::sin_($x);   # perl builtins get a _ suffix
+  print "@{$y->aslist}\n";
+
+=cut

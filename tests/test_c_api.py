@@ -20,6 +20,16 @@ import numpy as np
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpu_free_env(**extra):
+    """Env for subprocesses that must never claim the TPU tunnel."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_",
+                                "LIBTPU"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
 LIB = os.path.join(ROOT, "src", "libmxtpu.so")
 
 
@@ -228,11 +238,7 @@ def test_c_frontend_smoke(tmp_path):
         capture_output=True, text=True)
     if build.returncode != 0:
         pytest.skip(f"cannot compile C smoke: {build.stderr[-300:]}")
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_",
-                                "LIBTPU"))}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = ROOT
+    env = _tpu_free_env(PYTHONPATH=ROOT)
     r = subprocess.run([str(exe)], env=env, capture_output=True,
                        text=True, timeout=240)
     assert r.returncode == 0, (r.stdout, r.stderr[-500:])
@@ -380,3 +386,53 @@ int main() {
         for p in (src, binp):
             if os.path.exists(p):
                 os.remove(p)
+
+
+def test_perl_package_linreg_example(capi):
+    """The Perl binding (perl-package/, the reference's AI::MXNet
+    analog) trains linear regression through the C ABI only: XS shim
+    over libmxtpu.so + generated typed op wrappers
+    (OpWrapperGenerator.py over the live registry)."""
+    import shutil
+
+    if shutil.which("perl") is None:
+        pytest.skip("no perl")
+    pp = os.path.join(ROOT, "perl-package")
+    env = _tpu_free_env(PYTHONPATH=ROOT)
+    mm = subprocess.run(["perl", "-MExtUtils::MakeMaker", "-e", "1"],
+                        capture_output=True, text=True)
+    if mm.returncode != 0:
+        pytest.skip("no ExtUtils::MakeMaker")
+    mk = subprocess.run(["perl", "Makefile.PL"], cwd=pp, env=env,
+                        capture_output=True, text=True)
+    assert mk.returncode == 0, mk.stderr[-500:]
+    bld = subprocess.run(["make"], cwd=pp, env=env,
+                         capture_output=True, text=True)
+    assert bld.returncode == 0, bld.stderr[-500:]
+    env["PERL5LIB"] = os.pathsep.join(
+        [os.path.join(pp, "blib", "lib"),
+         os.path.join(pp, "blib", "arch")])
+    r = subprocess.run(["perl", os.path.join(pp, "example",
+                                             "linreg.pl")],
+                       cwd=ROOT, env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-300:], r.stderr[-300:])
+    assert "PASS" in r.stdout
+
+
+def test_perl_ops_pm_is_fresh():
+    """The checked-in generated wrappers match the live registry (the
+    same freshness guard the cpp-package generated header has)."""
+    import tempfile
+
+    gen = os.path.join(ROOT, "perl-package", "OpWrapperGenerator.py")
+    committed = os.path.join(ROOT, "perl-package", "lib", "AI",
+                             "MXNetTPU", "Ops.pm")
+    env = _tpu_free_env(PYTHONPATH=ROOT)
+    with tempfile.NamedTemporaryFile("r", suffix=".pm") as tmp:
+        r = subprocess.run([sys.executable, gen, "-o", tmp.name],
+                           env=env, capture_output=True, text=True,
+                           timeout=240)
+        assert r.returncode == 0, r.stderr[-400:]
+        assert open(committed).read() == open(tmp.name).read(), \
+            "Ops.pm is stale: re-run perl-package/OpWrapperGenerator.py"
