@@ -193,8 +193,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     # pre-pass: the float input symbol of every quantizable node
     points = []
     for node in topo:
-        if node.op in _QUANTIZABLE and node.name not in excluded \
-                and node.inputs:
+        if _is_quantizable(node, excluded) and node.inputs:
             points.append((node.name, _as_entry(node.inputs[0])))
 
     calib_ranges = {}
@@ -207,6 +206,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             calib_mode, params=bound)
 
     qarg_params = dict(arg_params or {})
+    bias_ranges = {}  # bias name -> absmax (shared-bias reuse guard)
     rebuilt = {}  # original node name -> rebuilt Symbol (node-level)
 
     def lookup(entry):
@@ -225,7 +225,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             rebuilt[node.name] = v
             continue
         ins = [lookup(_as_entry(i)) for i in node.inputs]
-        if node.op in _QUANTIZABLE and node.name not in excluded:
+        if _is_quantizable(node, excluded):
             data_s = ins[0]
             w_entry = _as_entry(node.inputs[1])
             w_name = w_entry.name
@@ -238,14 +238,24 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             if w_nd is None:
                 raise MXNetError(
                     f"quantize_model: missing weight param {w_name}")
-            w_np = w_nd.asnumpy() if isinstance(w_nd, NDArray) \
-                else _np.asarray(w_nd)
-            w_absmax = float(max(abs(w_np.min()), abs(w_np.max()), 1e-8))
-            w_q = _np.clip(_np.round(w_np * (127.0 / w_absmax)),
-                           -127, 127).astype(_np.int8)
-            qarg_params[w_name] = _nd.array(w_q, dtype="int8")
-            qarg_params[w_name + "_min"] = _nd.array([-w_absmax])
-            qarg_params[w_name + "_max"] = _nd.array([w_absmax])
+            if w_name + "_max" in qarg_params:
+                # weight shared by two quantizable nodes: already int8
+                # codes — re-quantizing the CODES would compute scales
+                # from ~127-valued data; reuse the stored range instead
+                w_absmax = float(_np.asarray(
+                    qarg_params[w_name + "_max"].asnumpy()
+                    if isinstance(qarg_params[w_name + "_max"], NDArray)
+                    else qarg_params[w_name + "_max"])[0])
+            else:
+                w_np = w_nd.asnumpy() if isinstance(w_nd, NDArray) \
+                    else _np.asarray(w_nd)
+                w_absmax = float(max(abs(w_np.min()), abs(w_np.max()),
+                                     1e-8))
+                w_q = _np.clip(_np.round(w_np * (127.0 / w_absmax)),
+                               -127, 127).astype(_np.int8)
+                qarg_params[w_name] = _nd.array(w_q, dtype="int8")
+                qarg_params[w_name + "_min"] = _nd.array([-w_absmax])
+                qarg_params[w_name + "_max"] = _nd.array([w_absmax])
             w_var = rebuilt[w_name]
             wmin = _sym_mod.var(w_name + "_min")
             wmax = _sym_mod.var(w_name + "_max")
@@ -267,14 +277,21 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                 # bias + min/max, mirroring the reference's
                 # quantized-bias inputs
                 b_entry = _as_entry(node.inputs[2])
-                b_nd = qarg_params.get(b_entry.name)
-                b_np = b_nd.asnumpy() if isinstance(b_nd, NDArray) \
-                    else _np.asarray(b_nd)
-                b_absmax = float(max(abs(b_np.min()), abs(b_np.max()),
-                                     1e-8))
-                b_q = _np.clip(_np.round(b_np * (127.0 / b_absmax)),
-                               -127, 127).astype(_np.int8)
-                qarg_params[b_entry.name] = _nd.array(b_q, dtype="int8")
+                if b_entry.name in bias_ranges:
+                    # shared bias: already int8 codes — reuse the range
+                    # (same defect class as the shared-weight guard)
+                    b_absmax = bias_ranges[b_entry.name]
+                else:
+                    b_nd = qarg_params.get(b_entry.name)
+                    b_np = b_nd.asnumpy() if isinstance(b_nd, NDArray) \
+                        else _np.asarray(b_nd)
+                    b_absmax = float(max(abs(b_np.min()),
+                                         abs(b_np.max()), 1e-8))
+                    b_q = _np.clip(_np.round(b_np * (127.0 / b_absmax)),
+                                   -127, 127).astype(_np.int8)
+                    qarg_params[b_entry.name] = _nd.array(b_q,
+                                                          dtype="int8")
+                    bias_ranges[b_entry.name] = b_absmax
                 from ..symbol.symbol import _scalar_sym
                 bmin = _scalar_sym(-b_absmax)
                 bmax = _scalar_sym(b_absmax)
@@ -308,6 +325,29 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 def _as_entry(x):
     """Inputs may be stored as Symbol entries already."""
     return x
+
+
+def _nontrivial_dilate(attrs):
+    d = attrs.get("dilate")
+    if d is None:
+        return False
+    if isinstance(d, str):
+        d = d.strip("()[] ").replace(",", " ").split()
+    try:
+        return any(int(v) != 1 for v in d)
+    except (TypeError, ValueError):
+        return True  # unparseable: be conservative, keep it float
+
+
+def _is_quantizable(node, excluded):
+    """ADVICE r3: quantized_conv has no dilation support — a dilated
+    Convolution must stay float instead of being silently rewritten
+    into a non-dilated int8 conv (wrong results)."""
+    if node.op not in _QUANTIZABLE or node.name in excluded:
+        return False
+    if node.op == "Convolution" and _nontrivial_dilate(node.attrs):
+        return False
+    return True
 
 
 def quantize_net(network, calib_data=None, calib_mode="naive",

@@ -353,7 +353,7 @@ class HybridBlock(Block):
     def infer_type(self, *args):
         pass
 
-    def optimize_for(self, x, backend="XLA", **kwargs):
+    def optimize_for(self, x, backend="XLA", *extra, **kwargs):
         """Partition this block's traced graph for a subgraph backend
         and return a SymbolBlock running the partitioned graph with the
         current parameters bound (reference: HybridBlock.optimize_for,
@@ -363,10 +363,16 @@ class HybridBlock(Block):
 
         if not self._active:
             self.hybridize()
-        self(x)  # materialize deferred shapes / build the cache
-        sym = _sym.trace_block(self)
+        self(x, *extra)  # materialize deferred shapes / build the cache
+        # trace with explicit, ordered input names so multi-input blocks
+        # bind positionally in SymbolBlock (a hard-coded single 'data'
+        # var mis-binds them)
+        n_in = 1 + len(extra)
+        in_names = ["data"] if n_in == 1 else \
+            [f"data{i}" for i in range(n_in)]
+        sym = _sym.trace_block(self, inputs=in_names)
         psym = sym.optimize_for(backend, **kwargs)
-        sb = SymbolBlock(psym, [_sym.var("data")])
+        sb = SymbolBlock(psym, [_sym.var(n) for n in in_names])
         params = self.collect_params()
         for name, p in sb.params.items():
             if name in params:

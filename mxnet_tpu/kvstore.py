@@ -89,6 +89,39 @@ def _cross_process_allreduce(raw):
         out, mesh, PartitionSpec())
 
 
+def _cross_process_f16_allreduce(h16):
+    """fp16 wire format: the explicit sharding constraint forces the
+    ALL-GATHER to happen on the f16 array (half the DCN bytes), then
+    the per-device sum runs in f32 — f16 wire without f16-accumulation
+    overflow (a plain f16 all-reduce would sum in f16; a plain
+    upcast-then-sum would put f32 on the wire)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    key = ("f16", tuple(h16.shape), _device_fingerprint())
+    entry = _ALLREDUCE_CACHE.get(key)
+    if entry is None:
+        mesh = _per_process_mesh()
+        in_s = NamedSharding(mesh, PartitionSpec("w"))
+        out_s = NamedSharding(mesh, PartitionSpec())
+
+        def f(x):
+            g = jax.lax.with_sharding_constraint(x, out_s)  # gather f16
+            return g.astype(jnp.float32).sum(axis=0)
+
+        fn = jax.jit(f, in_shardings=in_s, out_shardings=out_s)
+        entry = (mesh, fn)
+        _ALLREDUCE_CACHE[key] = entry
+    mesh, fn = entry
+    garr = multihost_utils.host_local_array_to_global_array(
+        jnp.asarray(h16)[None], mesh, PartitionSpec("w"))
+    out = fn(garr)
+    return multihost_utils.global_array_to_host_local_array(
+        out, mesh, PartitionSpec())
+
+
 def _cross_process_compressed_allreduce(packed, n, threshold, dtype):
     """2-bit wire format: all-gather each worker's PACKED codes (uint8,
     4 grads/byte — the bytes that cross DCN), decode and sum on-device.
@@ -201,6 +234,10 @@ class KVStore:
             summed = _cross_process_compressed_allreduce(
                 packed, raw.size, gc.threshold, raw.dtype)
             summed = summed.reshape(raw.shape)
+        elif multi and gc.type == "fp16":
+            # f16 on the wire, f32 accumulation (overflow-safe)
+            qh = gc.quantize_fp16_wire(key, raw)
+            summed = _cross_process_f16_allreduce(qh).astype(raw.dtype)
         else:
             q = gc.quantize(key, raw)
             summed = _cross_process_allreduce(q) if multi else q

@@ -359,9 +359,10 @@ def _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
 
 # -- custom vjp ----------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, vma=()):
+    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k,
+                         vma=vma)
     return out
 
 
@@ -379,26 +380,29 @@ def _dense_ref(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k)
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, vma=()):
+    out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k,
+                           vma=vma)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, vma, res, g):
     q, k, v, out, lse = res
     return _flash_bwd_call(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k)
+                           block_k, vma=vma)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None):
+                    block_k=None, vma=()):
     """Blockwise fused attention; q,k,v: (B, H, T, D).
 
     ``block_q``/``block_k`` override the tile sizes (tests use small
-    blocks to exercise multi-block streaming at modest T)."""
+    blocks to exercise multi-block streaming at modest T).  ``vma``:
+    varying-mesh-axes set when calling from inside a check_vma=True
+    shard_map region (ring/ulysses)."""
     T = q.shape[2]
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -413,4 +417,5 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
             f"flash_attention: block sizes ({bq}, {bk}) must divide "
             f"sequence length {T} (a non-dividing block would silently "
             f"leave tail blocks unwritten)")
-    return _flash_core(q, k, v, bool(causal), float(scale), bq, bk)
+    return _flash_core(q, k, v, bool(causal), float(scale), bq, bk,
+                       tuple(vma))

@@ -401,6 +401,12 @@ def ulysses_attention(q, k, v, mesh=None, axis=SP, causal=False,
     spec = PartitionSpec(batch_ax, None, axis, None)
     (q, k, v), eager = _place(mesh, spec, q, k, v)
 
+    from ..ops.pallas_attention import (_LANE, _use_interpret,
+                                        flash_attention)
+
+    T_full = q.shape[2]
+    use_flash = _use_interpret() or T_full % _LANE == 0
+
     def local(q, k, v):
         # (B, H, T/p, D) → (B, H/p, T, D): gather sequence, scatter heads
         def seq2head(x):
@@ -412,17 +418,29 @@ def ulysses_attention(q, k, v, mesh=None, axis=SP, causal=False,
                                   tiled=True)
 
         qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf.astype(jnp.float32),
-                       kf.astype(jnp.float32)) * scale
-        if causal:
-            T = s.shape[-1]
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        of = jnp.einsum("bhqk,bhkd->bhqd", p,
-                        vf.astype(jnp.float32)).astype(q.dtype)
+        if use_flash:
+            # full-sequence attention for T/p of the heads through the
+            # streaming flash kernel (custom-vjp, so Ulysses stays
+            # differentiable) — the (T × T) score matrix is never
+            # resident, same long-context property as the ring path
+            of = flash_attention(qf, kf, vf, causal=causal, scale=scale,
+                                 vma=_vma_of(qf))
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf.astype(jnp.float32),
+                           kf.astype(jnp.float32)) * scale
+            if causal:
+                T = s.shape[-1]
+                mask = jnp.tril(jnp.ones((T, T), bool))
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            of = jnp.einsum("bhqk,bhkd->bhqd", p,
+                            vf.astype(jnp.float32)).astype(q.dtype)
         return head2seq(of)
 
+    # check_vma off only for interpret-mode flash (same jax-internal
+    # limitation as the ring path); on TPU the vma plumbs through
+    # flash_attention's out_shapes and the check stays on
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec)
+                   out_specs=spec,
+                   check_vma=not (use_flash and _use_interpret()))
     return _uncommit(fn(q, k, v), eager)

@@ -99,6 +99,17 @@ def main():
     np.testing.assert_allclose(
         oc.asnumpy(), nw * np.array([-1.0, 1.0, 0.0, 1.0, 0.0]),
         atol=1e-6)
+    # -- fp16 compression: the WIRE carries f16 (ADVICE r3) ---------------------
+    kv4 = mx.kv.create("dist_sync")
+    kv4.set_gradient_compression({"type": "fp16"})
+    kv4.init("f0", mx.nd.zeros((4,)))
+    kv4.set_updater(lambda k, g, s: s._set_data((s + g)._data))
+    g16 = np.array([1.0009766, -2.0, 0.333333, 4096.5], np.float32)
+    kv4.push("f0", mx.nd.array(g16))
+    of = mx.nd.zeros((4,))
+    kv4.pull("f0", out=of)
+    expect = nw * np.float16(g16).astype(np.float32)
+    np.testing.assert_allclose(of.asnumpy(), expect, rtol=1e-3)
     print(f"worker {rank}/{nw}: dist_sync_kvstore OK", flush=True)
 
 

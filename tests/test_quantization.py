@@ -129,3 +129,27 @@ def test_quantize_net_nonzero_bias_preserved():
     qnet = qz.quantize_net(net, calib_data=[x], calib_mode="naive")
     out = qnet(x).asnumpy()
     assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+
+def test_entropy_threshold_known_distribution():
+    """Calibration fixture (ADVICE r3 Weak #9): on a distribution with
+    a dense Gaussian core and rare far outliers, KL-optimal calibration
+    must clip the outliers (threshold well below absmax, covering the
+    core), while naive calibration returns absmax."""
+    from mxnet_tpu.contrib.quantization import _get_optimal_threshold
+
+    rs = np.random.RandomState(0)
+    core = rs.normal(0.0, 1.0, 100_000)
+    outliers = np.array([50.0, -50.0, 48.0])     # 3 of 100k at |x|~50
+    arr = np.concatenate([core, outliers])
+    t = _get_optimal_threshold(arr)
+    absmax = float(np.abs(arr).max())
+    # clips the outliers...
+    assert t < 0.5 * absmax, (t, absmax)
+    # ...but keeps the Gaussian core (≥ ~4 sigma: <0.01% clipped mass)
+    assert t > 3.5, t
+    # degenerate inputs stay sane
+    assert abs(_get_optimal_threshold(np.zeros(16)) - 1e-8) < 1e-12
+    # a uniform distribution has nothing to clip: threshold ~ absmax
+    u = rs.uniform(-2, 2, 50_000)
+    assert _get_optimal_threshold(u) > 1.8

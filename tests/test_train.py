@@ -6,6 +6,7 @@ threshold.  This is the go/no-go milestone of SURVEY.md §7.3.
 """
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon
@@ -103,3 +104,55 @@ def test_speedometer_reports():
                                   eval_metric=metric))
     assert speedometer.last_speed is not None and \
         speedometer.last_speed > 0
+
+
+def test_real_data_convergence_digits():
+    """REAL-data convergence artifact (VERDICT r3 task #7): the UCI
+    handwritten-digits dataset (1797 genuine 8x8 scans, shipped inside
+    scikit-learn — an offline-cached real dataset, not synthetic blobs)
+    trained to a stated held-out accuracy.  Published baselines put
+    simple classifiers at ~0.95-0.97 on this split; the CNN must reach
+    0.95.  The ImageNet-scale recipe for chip runs is
+    examples/train_imagenet_sharded.py (docs/perf.md)."""
+    pytest.importorskip("sklearn")
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    x = (digits.images.astype(np.float32) / 16.0)[:, None]  # (N,1,8,8)
+    y = digits.target.astype(np.float32)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_train = 1500
+    xtr, ytr = x[:n_train], y[:n_train]
+    xte, yte = x[n_train:], y[n_train:]
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Conv2D(32, 3, padding=1, activation="relu"),
+                nn.Flatten(),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size=100,
+                                   shuffle=True)
+    for epoch in range(12):
+        train_iter.reset()
+        for batch in train_iter:
+            xb, yb = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    pred = net(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    acc = float((pred == yte).mean())
+    assert acc >= 0.95, f"held-out accuracy {acc:.3f} < 0.95"
