@@ -80,6 +80,19 @@ def _stype_dispatch(opdef, args, kwargs):
         stype = kwargs.get("stype", args[1] if len(args) > 1
                            else "default")
         return sparse_cast(args[0], stype)
+    elif opdef.name in ("elemwise_add", "broadcast_add", "add",
+                        "elemwise_mul", "broadcast_mul", "multiply") \
+            and len(args) >= 2:
+        from .sparse import RowSparseNDArray
+        from .sparse import _on_eager_tape
+        from .sparse import add as rsp_add
+        from .sparse import elemwise_mul as rsp_mul
+
+        if isinstance(args[0], RowSparseNDArray) and \
+                isinstance(args[1], RowSparseNDArray) and \
+                not _on_eager_tape(args[0], args[1]):
+            fn = rsp_add if "add" in opdef.name else rsp_mul
+            return fn(args[0], args[1])
     return None
 
 

@@ -421,6 +421,109 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return _Fn()(lhs, rhs)
 
 
+def _coalesced_parts(rsp):
+    ct = _RowSparseCt(rsp._rs_indices, rsp._rs_values,
+                      rsp._logical_shape).coalesce()
+    return ct.indices, ct.values
+
+
+def _on_eager_tape(*arrs):
+    """True when autograd is recording and an operand is on the tape —
+    the compact fast paths below do not record, so they must defer to
+    the dense op (which does) rather than silently drop gradients."""
+    from .. import autograd as _ag
+
+    return _ag.is_recording() and any(
+        getattr(a, "_on_tape", lambda: False)() for a in arrs)
+
+
+def _select_stored_rows(idx_sorted, wanted_sorted):
+    """Positions (host numpy) of idx_sorted entries present in
+    wanted_sorted — the one row-intersection helper retain and
+    elemwise_mul share."""
+    mask = _np.isin(_np.asarray(idx_sorted), _np.asarray(wanted_sorted))
+    return _np.nonzero(mask)[0]
+
+
+def add(lhs, rhs):
+    """Compact row-sparse add (reference: mx.nd.sparse.add /
+    elemwise_add FComputeEx rsp+rsp kernel): concat + coalesce —
+    O(K1+K2), never a dense row-dim buffer.  Mixed sparse/dense — or
+    operands on the autograd tape (the compact path doesn't record) —
+    fall back to the dense op."""
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray) and \
+            not _on_eager_tape(lhs, rhs):
+        if lhs._logical_shape != rhs._logical_shape:
+            raise MXNetError(
+                f"sparse.add: shape mismatch {lhs._logical_shape} vs "
+                f"{rhs._logical_shape}")
+        import jax.numpy as jnp
+
+        dt = jnp.promote_types(lhs.dtype, rhs.dtype)
+        ct = _RowSparseCt(
+            jnp.concatenate([lhs._rs_indices, rhs._rs_indices]),
+            jnp.concatenate([lhs._rs_values.astype(dt),
+                             rhs._rs_values.astype(dt)]),
+            lhs._logical_shape).coalesce()
+        return RowSparseNDArray(ct.indices, ct.values,
+                                lhs._logical_shape, lhs._ctx)
+    from .register import invoke_registered
+
+    return invoke_registered("elemwise_add", (lhs, rhs), {})
+
+
+def elemwise_mul(lhs, rhs):
+    """Compact row-sparse multiply: the result's rows are the
+    INTERSECTION of stored rows (reference: elemwise_mul rsp·rsp).
+    Tape-recorded operands fall back dense, as in add()."""
+    if not (isinstance(lhs, RowSparseNDArray)
+            and isinstance(rhs, RowSparseNDArray)) \
+            or _on_eager_tape(lhs, rhs):
+        from .register import invoke_registered
+
+        return invoke_registered("elemwise_mul", (lhs, rhs), {})
+    if lhs._logical_shape != rhs._logical_shape:
+        raise MXNetError(
+            f"sparse.elemwise_mul: shape mismatch {lhs._logical_shape} "
+            f"vs {rhs._logical_shape}")
+    import jax.numpy as jnp
+
+    li, lv = _coalesced_parts(lhs)
+    ri, rv = _coalesced_parts(rhs)
+    dt = jnp.promote_types(lhs.dtype, rhs.dtype)
+    if int(ri.shape[0]) == 0 or int(li.shape[0]) == 0:
+        return zeros("row_sparse", lhs._logical_shape, lhs._ctx, dt)
+    keep = _select_stored_rows(li, ri)
+    idx = jnp.asarray(keep, jnp.int32)
+    out_rows = jnp.take(li, idx)
+    # position of each kept l-row inside r (both sorted post-coalesce)
+    rpos = jnp.searchsorted(ri, out_rows)
+    out_vals = jnp.take(lv, idx, axis=0).astype(dt) * jnp.take(
+        rv, rpos, axis=0).astype(dt)
+    return RowSparseNDArray(out_rows, out_vals, lhs._logical_shape,
+                            lhs._ctx)
+
+
+def retain(arr, indices):
+    """Keep only the requested rows of a RowSparseNDArray (reference:
+    mx.nd.sparse.retain, the kvstore row_sparse_pull primitive) —
+    compact in, compact out."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse.retain expects a RowSparseNDArray")
+    import jax.numpy as jnp
+
+    want = _np.unique(_np.asarray(
+        getattr(indices, "asnumpy", lambda: indices)()).astype(
+        _np.int64).ravel())
+    si, sv = _coalesced_parts(arr)
+    keep = _select_stored_rows(si, want)
+    idx = jnp.asarray(keep, jnp.int32)
+    return RowSparseNDArray(jnp.take(si, idx),
+                            jnp.take(sv, idx, axis=0),
+                            arr._logical_shape, arr._ctx)
+
+
 def cast_storage(arr, stype):
     """Real storage casting at the NDArray level (reference:
     mx.nd.cast_storage, src/operator/tensor/cast_storage.cc): produces

@@ -384,3 +384,70 @@ def test_cast_storage_preserves_dtype():
     assert back.asnumpy().dtype == np.int32
     np.testing.assert_array_equal(back.asnumpy(),
                                   np.arange(6).reshape(2, 3))
+
+
+def test_sparse_elemwise_compact():
+    """Compact row-sparse add / elemwise_mul / retain (reference:
+    FComputeEx rsp kernels + mx.nd.sparse.retain): results stay
+    compact — stored rows are union / intersection / selection, never
+    a dense row-dim buffer."""
+    a = row_sparse_array(
+        (np.array([[1., 2.], [3., 4.]], np.float32), [1, 5]),
+        shape=(100, 2))
+    b = row_sparse_array(
+        (np.array([[10., 20.], [30., 40.]], np.float32), [5, 7]),
+        shape=(100, 2))
+
+    s = sp.add(a, b)
+    assert isinstance(s, RowSparseNDArray) and s.num_stored_rows == 3
+    np.testing.assert_array_equal(s.indices.asnumpy(), [1, 5, 7])
+    np.testing.assert_allclose(
+        s.asnumpy()[[1, 5, 7]], [[1, 2], [13, 24], [30, 40]])
+
+    m = sp.elemwise_mul(a, b)
+    assert isinstance(m, RowSparseNDArray) and m.num_stored_rows == 1
+    np.testing.assert_array_equal(m.indices.asnumpy(), [5])
+    np.testing.assert_allclose(m.asnumpy()[5], [30., 80.])
+
+    r = sp.retain(a, nd.array([5, 60]))
+    assert isinstance(r, RowSparseNDArray) and r.num_stored_rows == 1
+    np.testing.assert_array_equal(r.indices.asnumpy(), [5])
+    np.testing.assert_allclose(r.data.asnumpy(), [[3., 4.]])
+
+    # empty intersection
+    c = row_sparse_array(
+        (np.array([[9., 9.]], np.float32), [50]), shape=(100, 2))
+    e = sp.elemwise_mul(a, c)
+    assert e.num_stored_rows == 0
+    # mixed sparse/dense falls back dense
+    d = sp.add(a, nd.ones((100, 2)))
+    assert not isinstance(d, RowSparseNDArray)
+    np.testing.assert_allclose(d.asnumpy()[1], [2., 3.])
+
+
+def test_sparse_elemwise_dispatch_and_tape_fallback():
+    """rsp+rsp routes compact through EVERY entry point (nd.elemwise_add,
+    the + operator) via the invoke-layer dispatch; operands on the
+    autograd tape fall back to the dense recording path so gradients
+    are never silently dropped."""
+    a = row_sparse_array(
+        (np.array([[1., 2.]], np.float32), [3]), shape=(50, 2))
+    b = row_sparse_array(
+        (np.array([[5., 6.]], np.float32), [3]), shape=(50, 2))
+    s1 = nd.elemwise_add(a, b)
+    assert isinstance(s1, RowSparseNDArray) and s1.num_stored_rows == 1
+    s2 = a + b
+    assert isinstance(s2, RowSparseNDArray)
+    np.testing.assert_allclose(s2.asnumpy()[3], [6., 8.])
+    m = nd.elemwise_mul(a, b)
+    assert isinstance(m, RowSparseNDArray)
+    np.testing.assert_allclose(m.asnumpy()[3], [5., 12.])
+
+    # tape fallback: dense path records, gradients flow
+    x = nd.ones((50, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = sp.add(a.tostype("default") * 0 + x, a)  # dense + sparse
+        loss = (y * y).sum()
+    loss.backward()
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
