@@ -292,7 +292,27 @@ class KVStore:
                 raise MXNetError(f"key {k} not initialized")
             stored = self._store[k]
             idx = jnp.unique(rid._data.astype(jnp.int32).reshape(-1))
-            vals = jnp.take(stored._data, idx, axis=0)
+            if isinstance(stored, RowSparseNDArray):
+                # compact store: gather requested rows from the stored
+                # parts (absent rows pull zeros) — the dense `_data`
+                # view would materialize the whole table
+                from .ndarray.sparse import _coalesced_parts
+
+                si, sv = _coalesced_parts(stored)
+                if int(si.shape[0]) == 0:
+                    vals = jnp.zeros((int(idx.shape[0]),)
+                                     + stored.shape[1:], stored.dtype)
+                else:
+                    pos = jnp.clip(jnp.searchsorted(si, idx), 0,
+                                   int(si.shape[0]) - 1)
+                    hit = si[pos] == idx
+                    shape_tail = (1,) * (sv.ndim - 1)
+                    vals = jnp.where(
+                        hit.reshape((-1,) + shape_tail),
+                        jnp.take(sv, pos, axis=0),
+                        jnp.zeros((), sv.dtype))
+            else:
+                vals = jnp.take(stored._data, idx, axis=0)
             for dst in _as_list(o):
                 if isinstance(dst, RowSparseNDArray):
                     dst._set_sparse(idx, vals)

@@ -451,3 +451,33 @@ def test_sparse_elemwise_dispatch_and_tape_fallback():
         loss = (y * y).sum()
     loss.backward()
     assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+
+
+def test_kvstore_row_sparse_pull_compact_store():
+    """row_sparse_pull on a row-sparse STORE gathers from the compact
+    parts — the full dense table is never materialized (asserted by
+    poisoning the dense view during the pull)."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    w = row_sparse_array(
+        (np.array([[1., 1.], [2., 2.], [3., 3.]], np.float32),
+         [2, 7, 11]), shape=(1000, 2))
+    kv.init("emb", w)
+    out = sp.zeros("row_sparse", (1000, 2))
+    poisoned = {"hit": False}
+    orig = RowSparseNDArray._data
+
+    def boom(self):
+        poisoned["hit"] = True
+        return orig.fget(self)
+
+    try:
+        RowSparseNDArray._data = property(boom, orig.fset)
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([7, 500]))
+    finally:
+        RowSparseNDArray._data = orig
+    assert not poisoned["hit"], "dense view materialized during pull"
+    np.testing.assert_array_equal(out.indices.asnumpy(), [7, 500])
+    np.testing.assert_allclose(out.data.asnumpy(),
+                               [[2., 2.], [0., 0.]])
