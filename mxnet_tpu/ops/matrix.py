@@ -90,6 +90,12 @@ def reshape(data, shape=None, reverse=False):
 
 @register("reshape_like")
 def reshape_like(lhs, rhs):
+    if isinstance(rhs, (tuple, list)) or isinstance(lhs, (tuple, list)):
+        # the classic foot-gun: a multi-output net's tuple fed to a loss
+        raise TypeError(
+            "reshape_like: got a tuple/list operand — a multi-output "
+            "network's result was passed where one array is expected "
+            "(select the output first, e.g. out[0])")
     return jnp.reshape(lhs, rhs.shape)
 
 

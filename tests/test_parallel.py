@@ -980,3 +980,29 @@ def test_ulysses_flash_differentiable(qkv):
     g_d = jax.grad(loss_d)(q)
     np.testing.assert_allclose(np.asarray(g_d), np.asarray(g_u),
                                rtol=2e-3, atol=2e-4)
+
+
+def test_bert_ring_attention_sharded_training():
+    """The long-context FLAGSHIP config: BERT with ring attention inside
+    the jitted ShardedTrainer whole-step over a dp×sp mesh — flash-ring
+    blocks, GSPMD dp gradients and the sp ring compose in ONE compiled
+    program and the loss decreases."""
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    mesh = parallel.make_mesh(dp=2, sp=4)
+    parallel.set_default_mesh(mesh)
+    try:
+        net = bert.bert_tiny(attention_impl="ring", use_decoder=False,
+                             use_pooler=False)
+        net.initialize(init=mx.init.Xavier())
+        tr = parallel.ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
+                                     {"learning_rate": 1e-3}, mesh=mesh)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 100, (4, 64)).astype(np.int32)
+        tgt = rs.randn(4, 64, 64).astype(np.float32)
+        losses = [float(np.asarray(
+            tr.step(mx.nd.array(ids), mx.nd.array(tgt))._data,
+            dtype=np.float32)) for _ in range(3)]
+        assert losses[-1] < losses[0], losses
+    finally:
+        parallel.set_default_mesh(None)
