@@ -11,10 +11,20 @@ Format (little-endian), best-effort byte-compatible with the reference's
   ndarray:    uint32 0xF993fac9 (NDARRAY_V2_MAGIC), int32 stype (-1 dense),
               uint32 ndim, int64[ndim] shape, int32 dev_type, int32 dev_id,
               int32 type_flag, raw data bytes
+  sparse:     uint32 0xF993facA (OUR extension magic — upstream's v2
+              sparse layout differs and cannot be byte-verified against
+              the empty mount, so fork records use a distinct magic and
+              upstream sparse files still fail with a clean error),
+              int32 stype (1 row_sparse, 2 csr), uint32 ndim,
+              int64[ndim] logical shape, int32 dev_type, int32 dev_id,
+              int32 type_flag, then
+                row_sparse: uint64 K, int64[K] indices, raw values
+                csr:        uint64 nnz, int64[nnz] indices,
+                            uint64 P, int64[P] indptr, raw data
 
 NOTE: the reference mount was empty at survey time (SURVEY.md preamble);
-field order follows upstream apache/incubator-mxnet 1.x and must be
-re-verified against the fork if the mount is populated.
+dense field order follows upstream apache/incubator-mxnet 1.x and must
+be re-verified against the fork if the mount is populated.
 """
 
 from __future__ import annotations
@@ -29,6 +39,7 @@ from .ndarray import NDArray, _from_jax
 _LIST_MAGIC = 0x112
 _ND_MAGIC_V2 = 0xF993FAC9
 _ND_MAGIC_V1 = 0xF993FAC8
+_ND_MAGIC_SPARSE = 0xF993FACA  # fork extension (see module docstring)
 
 # reference type flags (mshadow/base.h)
 _TYPE_FLAGS = {
@@ -40,28 +51,75 @@ _FLAG_TYPES = {v: k for k, v in _TYPE_FLAGS.items()}
 _BF16_FLAG = 12  # extension flag for bfloat16 (not in 1.x reference)
 
 
-def _save_ndarray(f, arr: NDArray):
-    a = arr.asnumpy()
+_STYPE_ROW_SPARSE = 1
+_STYPE_CSR = 2
+
+
+def _write_header(f, magic, stype, shape, flag):
+    f.write(struct.pack("<I", magic))
+    f.write(struct.pack("<i", stype))
+    f.write(struct.pack("<I", len(shape)))
+    if shape:
+        f.write(struct.pack(f"<{len(shape)}q", *shape))
+    f.write(struct.pack("<ii", 1, 0))  # context: cpu(0), stripped on save
+    f.write(struct.pack("<i", flag))
+
+
+def _read_flag_values(f, flag, n_elems, shape):
+    """Decode n_elems values of the given type flag into a jnp array."""
+    import jax.numpy as jnp
+
+    if flag == _BF16_FLAG:
+        raw = _np.frombuffer(f.read(2 * n_elems), dtype=_np.uint16)
+        return jnp.asarray(raw).view(jnp.bfloat16).reshape(shape)
+    dt = _FLAG_TYPES[flag]
+    raw = _np.frombuffer(f.read(dt.itemsize * n_elems), dtype=dt)
+    return jnp.asarray(raw.reshape(shape))
+
+
+def _flag_and_raw(a):
     dt = a.dtype
     if dt.name == "bfloat16":
-        flag = _BF16_FLAG
-        raw = a.view(_np.uint16)
-    elif dt == _np.dtype("bool"):
+        return _BF16_FLAG, a.view(_np.uint16)
+    if dt == _np.dtype("bool"):
         a = a.astype("uint8")
-        flag = _TYPE_FLAGS[a.dtype]
-        raw = a
-    else:
-        if dt not in _TYPE_FLAGS:
-            a = a.astype("float32")
-            dt = a.dtype
-        flag = _TYPE_FLAGS[dt]
-        raw = a
-    f.write(struct.pack("<I", _ND_MAGIC_V2))
-    f.write(struct.pack("<i", -1))  # dense storage type
-    f.write(struct.pack("<I", a.ndim))
-    f.write(struct.pack(f"<{a.ndim}q", *a.shape))
-    f.write(struct.pack("<ii", 1, 0))  # context: cpu(0) — ctx stripped on save
-    f.write(struct.pack("<i", flag))
+        return _TYPE_FLAGS[a.dtype], a
+    if dt not in _TYPE_FLAGS:
+        a = a.astype("float32")
+    return _TYPE_FLAGS[a.dtype], a
+
+
+def _save_ndarray(f, arr: NDArray):
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    if isinstance(arr, RowSparseNDArray):
+        # compact record: a (10M, 512) embedding with 4k touched rows
+        # writes 4k rows, not 10M (reference: sparse NDArray::Save)
+        vals = _np.asarray(arr._rs_values)
+        idx = _np.asarray(arr._rs_indices, dtype=_np.int64)
+        flag, raw = _flag_and_raw(vals)
+        _write_header(f, _ND_MAGIC_SPARSE, _STYPE_ROW_SPARSE,
+                      arr._logical_shape, flag)
+        f.write(struct.pack("<Q", idx.shape[0]))
+        f.write(idx.tobytes())
+        f.write(raw.tobytes())
+        return
+    if isinstance(arr, CSRNDArray):
+        data = _np.asarray(arr._csr_data)
+        indices = _np.asarray(arr._csr_indices, dtype=_np.int64)
+        indptr = _np.asarray(arr._csr_indptr, dtype=_np.int64)
+        flag, raw = _flag_and_raw(data)
+        _write_header(f, _ND_MAGIC_SPARSE, _STYPE_CSR,
+                      arr._logical_shape, flag)
+        f.write(struct.pack("<Q", data.shape[0]))
+        f.write(indices.tobytes())
+        f.write(struct.pack("<Q", indptr.shape[0]))
+        f.write(indptr.tobytes())
+        f.write(raw.tobytes())
+        return
+    a = arr.asnumpy()
+    flag, raw = _flag_and_raw(a)
+    _write_header(f, _ND_MAGIC_V2, -1, tuple(a.shape), flag)
     f.write(raw.tobytes())
 
 
@@ -69,11 +127,22 @@ def _load_ndarray(f) -> NDArray:
     import jax.numpy as jnp
 
     (magic,) = struct.unpack("<I", f.read(4))
+    if magic == _ND_MAGIC_SPARSE:
+        (stype,) = struct.unpack("<i", f.read(4))
+        if stype not in (_STYPE_ROW_SPARSE, _STYPE_CSR):
+            raise MXNetError(f"unknown sparse storage type {stype}")
+        (ndim,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+        return _load_sparse(f, stype, shape)
     if magic == _ND_MAGIC_V2:
         (stype,) = struct.unpack("<i", f.read(4))
-        if stype not in (-1,):
-            raise MXNetError(f"sparse storage type {stype} in file not "
-                             "supported (dense-only on TPU)")
+        if stype != -1:
+            # upstream v2 SPARSE layout (aux types/shapes before data)
+            # is not byte-verifiable against the empty reference mount —
+            # reject loudly instead of misparsing; fork-written sparse
+            # records use _ND_MAGIC_SPARSE
+            raise MXNetError(f"sparse storage type {stype} under the "
+                             "upstream v2 magic is not supported")
         (ndim,) = struct.unpack("<I", f.read(4))
         shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
     elif magic == _ND_MAGIC_V1:
@@ -86,14 +155,29 @@ def _load_ndarray(f) -> NDArray:
     n = 1
     for s in shape:
         n *= s
-    if flag == _BF16_FLAG:
-        raw = _np.frombuffer(f.read(2 * n), dtype=_np.uint16)
-        arr = jnp.asarray(raw).view(jnp.bfloat16).reshape(shape)
-    else:
-        dt = _FLAG_TYPES[flag]
-        raw = _np.frombuffer(f.read(dt.itemsize * n), dtype=dt)
-        arr = jnp.asarray(raw.reshape(shape))
-    return _from_jax(arr)
+    return _from_jax(_read_flag_values(f, flag, n, shape))
+
+
+def _load_sparse(f, stype, shape):
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    struct.unpack("<ii", f.read(8))  # context
+    (flag,) = struct.unpack("<i", f.read(4))
+    if stype == _STYPE_ROW_SPARSE:
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        (k,) = struct.unpack("<Q", f.read(8))
+        idx = _np.frombuffer(f.read(8 * k), dtype=_np.int64)
+        vals = _read_flag_values(f, flag, k * cols,
+                                 (k,) + tuple(shape[1:]))
+        return RowSparseNDArray(idx, vals, shape)
+    (nnz,) = struct.unpack("<Q", f.read(8))
+    indices = _np.frombuffer(f.read(8 * nnz), dtype=_np.int64)
+    (nptr,) = struct.unpack("<Q", f.read(8))
+    indptr = _np.frombuffer(f.read(8 * nptr), dtype=_np.int64)
+    data = _read_flag_values(f, flag, nnz, (nnz,))
+    return CSRNDArray(data, indices, indptr, shape)
 
 
 def save(fname: str, data) -> None:

@@ -481,3 +481,30 @@ def test_kvstore_row_sparse_pull_compact_store():
     np.testing.assert_array_equal(out.indices.asnumpy(), [7, 500])
     np.testing.assert_allclose(out.data.asnumpy(),
                                [[2., 2.], [0., 0.]])
+
+
+def test_sparse_save_load_roundtrip(tmp_path):
+    """nd.save/load round-trips sparse arrays COMPACTLY with stype
+    preserved (reference: sparse NDArray::Save) — a row-sparse record
+    stores K rows, not the logical row count; dense records are
+    byte-identical to before."""
+    import os
+
+    a = row_sparse_array(
+        (np.array([[1., 2.], [3., 4.]], np.float32), [5, 9000]),
+        shape=(10000, 2))
+    c = csr_matrix(np.eye(4, dtype=np.float32))
+    d = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    p = str(tmp_path / "s.params")
+    nd.save(p, {"a": a, "c": c, "d": d})
+    sz = os.path.getsize(p)
+    assert sz < 4096, sz  # compact: dense-a alone would be 80 KB
+    back = nd.load(p)
+    assert isinstance(back["a"], RowSparseNDArray)
+    assert back["a"].num_stored_rows == 2
+    np.testing.assert_array_equal(back["a"].indices.asnumpy(),
+                                  [5, 9000])
+    np.testing.assert_allclose(back["a"].asnumpy(), a.asnumpy())
+    assert isinstance(back["c"], CSRNDArray)
+    np.testing.assert_allclose(back["c"].asnumpy(), np.eye(4))
+    np.testing.assert_allclose(back["d"].asnumpy(), d.asnumpy())
