@@ -25,7 +25,8 @@ class TransformerEncoderLayer(HybridBlock):
     """Pre-LN transformer encoder layer."""
 
     def __init__(self, units, num_heads, hidden_size=None, dropout=0.1,
-                 attention_impl="dense", activation="gelu", **kwargs):
+                 attention_impl="dense", activation="gelu",
+                 causal=False, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
@@ -34,6 +35,7 @@ class TransformerEncoderLayer(HybridBlock):
         self._attention_impl = attention_impl
         self._dropout = dropout
         self._activation = activation
+        self._causal = causal
         with self.name_scope():
             self.qkv_weight = self.params.get("qkv_weight",
                                               shape=(3 * units, units))
@@ -65,7 +67,7 @@ class TransformerEncoderLayer(HybridBlock):
             h, h, h, qkv_weight=qkv_weight, qkv_bias=qkv_bias,
             proj_weight=proj_weight, proj_bias=proj_bias,
             num_heads=self._num_heads, mask=mask,
-            impl=self._attention_impl)
+            impl=self._attention_impl, causal=self._causal)
         if self._dropout:
             attn = self.drop(attn)
         x = x + attn
@@ -84,7 +86,8 @@ class TransformerEncoderLayer(HybridBlock):
 
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, num_heads, hidden_size=None,
-                 dropout=0.1, attention_impl="dense", **kwargs):
+                 dropout=0.1, attention_impl="dense", causal=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._num_layers = num_layers
         with self.name_scope():
@@ -92,7 +95,8 @@ class TransformerEncoder(HybridBlock):
             for i in range(num_layers):
                 self.layers.add(TransformerEncoderLayer(
                     units, num_heads, hidden_size, dropout,
-                    attention_impl, prefix=f"layer{i}_"))
+                    attention_impl, causal=causal,
+                    prefix=f"layer{i}_"))
             self.ln_f = nn.LayerNorm(in_channels=units)
 
     def hybrid_forward(self, F, x):
@@ -116,11 +120,13 @@ class ScanTransformerEncoder(HybridBlock):
 
     def __init__(self, num_layers, units, num_heads, hidden_size=None,
                  dropout=0.1, attention_impl="dense",
-                 activation="gelu", remat=False, **kwargs):
+                 activation="gelu", remat=False, causal=False,
+                 **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         hidden_size = hidden_size or 4 * units
         self._remat = bool(remat)
+        self._causal = causal
         self._num_layers = num_layers
         self._units = units
         self._num_heads = num_heads
@@ -173,7 +179,8 @@ class ScanTransformerEncoder(HybridBlock):
             ln1_stack_beta, ln2_stack_gamma, ln2_stack_beta,
             lnf_gamma, lnf_beta, num_heads=self._num_heads,
             dropout=self._dropout, activation=self._activation,
-            impl=self._attention_impl, remat=self._remat)
+            impl=self._attention_impl, causal=self._causal,
+            remat=self._remat)
 
 
 class BERTModel(HybridBlock):
@@ -255,6 +262,22 @@ class BERTModel(HybridBlock):
                 num_hidden=word_embed_weight.shape[0], flatten=False)
             outputs.append(logits)
         return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+
+def masked_token_ce(logits, labels):
+    """Mean token cross-entropy over valid (label >= 0) positions — the
+    ONE masked-CE implementation (BERTMLMLoss, the pretrain loss and
+    gpt.GPTLMLoss all delegate here)."""
+    import jax
+    import jax.numpy as jnp
+
+    labels = labels.astype(jnp.int32)
+    valid = labels >= 0
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
 
 
 def _bert_pretrain_loss_pure(nsp_logits, mlm_logits, mlm_labels,
@@ -358,20 +381,7 @@ class BERTMLMLoss(HybridBlock):
     def hybrid_forward(self, F, logits, labels):
         from ...ndarray.register import invoke_simple
 
-        def pure(logits, labels):
-            import jax
-            import jax.numpy as jnp
-
-            labels = labels.astype(jnp.int32)
-            valid = labels >= 0
-            logp = jax.nn.log_softmax(
-                logits.astype(jnp.float32), axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
-            denom = jnp.maximum(jnp.sum(valid), 1)
-            return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
-
-        return invoke_simple(pure, (logits, labels))
+        return invoke_simple(masked_token_ce, (logits, labels))
 
 
 def bert_pipeline_parts(vocab_size=30522, units=768, num_layers=12,

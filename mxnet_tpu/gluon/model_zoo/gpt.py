@@ -1,0 +1,140 @@
+"""Decoder-only (GPT-style) language model family.
+
+Beyond-reference breadth: the 2018-era reference zoo has no decoder-only
+LM (its nearest is example/rnn word_lm and the NMT Transformer decoder);
+this family completes the transformer spread — encoder (BERT),
+encoder-decoder (transformer.py NMT), decoder-only (here) — on the same
+TPU-first trunk primitives:
+
+- causal attention via the SAME packed-qkv MHA op (flash/ring/ulysses
+  ``attention_impl`` all apply — the long-context causal config);
+- ``scan_layers=True`` compiles the trunk as one scanned layer
+  (compile-time scalability, same as BERT's bench config);
+- the LM head is WEIGHT-TIED to the token embedding (standard GPT-2
+  parameterization): one (vocab, units) matrix serves both.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn
+from .bert import ScanTransformerEncoder, TransformerEncoder
+
+
+class GPTModel(HybridBlock):
+    """Token+position embedding → causal pre-LN trunk → tied-head
+    logits.  Input: (B, T) int token ids; output: (B, T, vocab)."""
+
+    def __init__(self, vocab_size=50257, units=768, num_layers=12,
+                 num_heads=12, max_length=1024, hidden_size=None,
+                 dropout=0.1, attention_impl="dense", scan_layers=False,
+                 remat=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self._dropout = dropout
+        with self.name_scope():
+            self.tok_embed_weight = self.params.get(
+                "tok_embed_weight", shape=(vocab_size, units))
+            self.pos_embed_weight = self.params.get(
+                "pos_embed_weight", shape=(max_length, units))
+            if scan_layers:
+                self.encoder = ScanTransformerEncoder(
+                    num_layers, units, num_heads, hidden_size, dropout,
+                    attention_impl, causal=True, remat=remat,
+                    prefix="trunk_")
+            else:
+                self.encoder = TransformerEncoder(
+                    num_layers, units, num_heads, hidden_size, dropout,
+                    attention_impl, causal=True, prefix="trunk_")
+            if dropout:
+                self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, ids, tok_embed_weight,
+                       pos_embed_weight):
+        x = F.Embedding(ids, tok_embed_weight,
+                        input_dim=tok_embed_weight.shape[0],
+                        output_dim=self._units)
+        T = ids.shape[1]
+        x = x + F.slice_axis(pos_embed_weight, axis=0, begin=0, end=T)
+        if self._dropout:
+            x = self.drop(x)
+        h = self.encoder(x)                       # (B, T, C)
+        # tied head: logits = h @ embedᵀ — one big MXU matmul
+        return F.dot(F.reshape(h, (-1, self._units)), tok_embed_weight,
+                     transpose_b=True).reshape(
+            (ids.shape[0], T, tok_embed_weight.shape[0]))
+
+
+def _lm_loss_pure(logits, labels):
+    """Shifted next-token cross-entropy; labels < 0 are ignored —
+    the shift plus the zoo's shared masked-CE."""
+    from .bert import masked_token_ce
+
+    return masked_token_ce(logits[:, :-1], labels[:, 1:])
+
+
+class GPTLMLoss(HybridBlock):
+    """Causal LM loss: mean next-token NLL over valid (>= 0) labels.
+    Call with (logits, token_ids) — the shift happens inside."""
+
+    def hybrid_forward(self, F, logits, labels):
+        from ...ndarray.register import invoke_simple
+
+        return invoke_simple(_lm_loss_pure, (logits, labels))
+
+
+def generate(model, ids, max_new_tokens=16, temperature=None, rng=None):
+    """Greedy (or sampled) decode by full-recompute per step — the
+    simple deploy path; ids: (B, T0) NDArray of seed tokens."""
+    import numpy as np
+
+    from ... import ndarray as nd
+
+    out = ids.asnumpy().astype(np.int32)
+    for _ in range(max_new_tokens):
+        ctx = out[:, -model._max_length:]
+        logits = model(nd.array(ctx.astype(np.float32))).asnumpy()
+        last = logits[:, -1]
+        if temperature:
+            z = last / temperature
+            z = z - z.max(axis=-1, keepdims=True)
+            p = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+            rng = rng or np.random.default_rng()
+            nxt = np.stack([rng.choice(p.shape[-1], p=row)
+                            for row in p])
+        else:
+            nxt = last.argmax(axis=-1)
+        out = np.concatenate([out, nxt[:, None].astype(np.int32)],
+                             axis=1)
+    return nd.array(out.astype(np.float32))
+
+
+def gpt2_small(**kwargs):
+    """GPT-2 124M config."""
+    kwargs.setdefault("vocab_size", 50257)
+    kwargs.setdefault("units", 768)
+    kwargs.setdefault("num_layers", 12)
+    kwargs.setdefault("num_heads", 12)
+    kwargs.setdefault("max_length", 1024)
+    return GPTModel(**kwargs)
+
+
+def gpt2_medium(**kwargs):
+    kwargs.setdefault("vocab_size", 50257)
+    kwargs.setdefault("units", 1024)
+    kwargs.setdefault("num_layers", 24)
+    kwargs.setdefault("num_heads", 16)
+    kwargs.setdefault("max_length", 1024)
+    return GPTModel(**kwargs)
+
+
+def gpt_tiny(**kwargs):
+    """Test-sized config."""
+    kwargs.setdefault("vocab_size", 128)
+    kwargs.setdefault("units", 32)
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("num_heads", 2)
+    kwargs.setdefault("max_length", 64)
+    kwargs.setdefault("dropout", 0.0)
+    return GPTModel(**kwargs)

@@ -153,7 +153,8 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
                              ln1_g, ln1_b, ln2_g, ln2_b, lnf_g, lnf_b,
                              num_heads=1, dropout=0.0,
                              activation="gelu", impl="dense",
-                             remat=False, _is_training=True, _key=None):
+                             causal=False, remat=False,
+                             _is_training=True, _key=None):
     """Pre-LN transformer trunk as ONE lax.scan over stacked (L, ...)
     per-layer parameters.
 
@@ -177,7 +178,8 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
         h = layer_norm(x, g1, b1)
         attn = multi_head_attention(
             h, h, h, qkv_weight=qw, qkv_bias=qb, proj_weight=pw,
-            proj_bias=pb, num_heads=num_heads, impl=impl)
+            proj_bias=pb, num_heads=num_heads, impl=impl,
+            causal=causal)
         if use_drop:
             k1, k2 = jax.random.split(key)
             keep = 1.0 - dropout
