@@ -285,14 +285,9 @@ def _bert_pretrain_loss_pure(nsp_logits, mlm_logits, mlm_labels,
     import jax
     import jax.numpy as jnp
 
-    valid = (mlm_labels >= 0)
-    safe_labels = jnp.maximum(mlm_labels, 0).astype(jnp.int32)
-    logp = jax.nn.log_softmax(mlm_logits, axis=-1)
-    nll = -jnp.take_along_axis(
-        logp, safe_labels[..., None], axis=-1)[..., 0]
-    denom = jnp.maximum(jnp.sum(valid), 1)
-    mlm_loss = jnp.sum(jnp.where(valid, nll, 0.0)) / denom
-    nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
+    mlm_loss = masked_token_ce(mlm_logits, mlm_labels)
+    nsp_logp = jax.nn.log_softmax(
+        nsp_logits.astype(jnp.float32), axis=-1)
     nsp_nll = -jnp.take_along_axis(
         nsp_logp, nsp_labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
     return mlm_loss + jnp.mean(nsp_nll)

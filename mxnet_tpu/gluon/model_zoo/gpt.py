@@ -86,16 +86,27 @@ class GPTLMLoss(HybridBlock):
 
 def generate(model, ids, max_new_tokens=16, temperature=None, rng=None):
     """Greedy (or sampled) decode by full-recompute per step — the
-    simple deploy path; ids: (B, T0) NDArray of seed tokens."""
+    simple deploy path; ids: (B, T0) NDArray of seed tokens.
+
+    The context is RIGHT-padded to max_length so every step runs at ONE
+    shape (one compile, critical on the slow-AOT TPU tunnel); causal
+    masking makes positions > cur-1 invisible to the read position, so
+    the pad content never matters."""
     import numpy as np
 
     from ... import ndarray as nd
 
     out = ids.asnumpy().astype(np.int32)
+    W = model._max_length
     for _ in range(max_new_tokens):
-        ctx = out[:, -model._max_length:]
+        ctx = out[:, -W:]
+        cur = ctx.shape[1]
+        if cur < W:
+            ctx = np.concatenate(
+                [ctx, np.zeros((ctx.shape[0], W - cur), np.int32)],
+                axis=1)
         logits = model(nd.array(ctx.astype(np.float32))).asnumpy()
-        last = logits[:, -1]
+        last = logits[:, cur - 1]
         if temperature:
             z = last / temperature
             z = z - z.max(axis=-1, keepdims=True)

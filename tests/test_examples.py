@@ -68,3 +68,8 @@ def test_example_sparse_linear_libsvm():
     out = _run("linear_classification_libsvm.py", "--dim", "2000",
                "--epochs", "10")
     assert "final accuracy" in out
+
+
+def test_example_gpt_char_lm():
+    out = _run("gpt_char_lm.py", "--steps", "120", timeout=500)
+    assert "char-LM OK" in out

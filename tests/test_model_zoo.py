@@ -136,6 +136,33 @@ def test_model_zoo_save_load(tmp_path):
                                atol=1e-6)
 
 
+def _copy_unstacked_to_scan(pa, pb, eprefix, sprefix, num_layers):
+    """Copy an unstacked transformer trunk's per-layer params into a
+    scan trunk's (L, ...) stacks — the one home of the *_stack_* naming
+    convention both equivalence tests rely on."""
+    from mxnet_tpu import nd
+
+    def stack(name):
+        return nd.array(np.stack(
+            [pa[f"{eprefix}layer{i}_{name}"].data().asnumpy()
+             for i in range(num_layers)]))
+
+    for nm in ("qkv_weight", "qkv_bias", "proj_weight", "proj_bias",
+               "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"):
+        pb[f"{sprefix}{nm.replace('_', '_stack_', 1)}"].set_data(
+            stack(nm))
+    for li, tag in ((0, "ln1"), (1, "ln2")):
+        for wb in ("gamma", "beta"):
+            pb[f"{sprefix}{tag}_stack_{wb}"].set_data(nd.array(np.stack(
+                [pa[f"{eprefix}layer{i}_layernorm{li}_{wb}"]
+                 .data().asnumpy() for i in range(num_layers)])))
+    for wb in ("gamma", "beta"):
+        final = [n for n in pa
+                 if n.startswith(f"{eprefix}layernorm")
+                 and n.endswith(wb)]
+        pb[f"{sprefix}lnf_{wb}"].set_data(pa[final[0]].data())
+
+
 def test_scan_transformer_encoder_matches_unstacked():
     """ScanTransformerEncoder (lax.scan trunk) must equal
     TransformerEncoder layer-by-layer math, fwd and grads."""
@@ -158,27 +185,7 @@ def test_scan_transformer_encoder_matches_unstacked():
     sp = senc.collect_params()
     spre = [n for n in sp if n.endswith("qkv_stack_weight")][0]
     sprefix = spre[:-len("qkv_stack_weight")]
-
-    def stack(name):
-        return nd.array(np.stack(
-            [ep[f"{eprefix}layer{i}_{name}"].data().asnumpy()
-             for i in range(L)]))
-
-    for nm in ("qkv_weight", "qkv_bias", "proj_weight", "proj_bias",
-               "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"):
-        sp[f"{sprefix}{nm.replace('_', '_stack_', 1)}"].set_data(
-            stack(nm))
-    for li, tag in ((0, "ln1"), (1, "ln2")):
-        for wb in ("gamma", "beta"):
-            sp[f"{sprefix}{tag}_stack_{wb}"].set_data(nd.array(np.stack(
-                [ep[f"{eprefix}layer{i}_layernorm{li}_{wb}"]
-                 .data().asnumpy() for i in range(L)])))
-    for wb in ("gamma", "beta"):
-        # final LN sits directly under the encoder prefix (no layer{i}_)
-        final = [n for n in ep
-                 if n.startswith(f"{eprefix}layernorm")
-                 and n.endswith(wb)]
-        sp[f"{sprefix}lnf_{wb}"].set_data(ep[final[0]].data())
+    _copy_unstacked_to_scan(ep, sp, eprefix, sprefix, L)
 
     x = nd.array(rs.randn(2, 5, U).astype("float32"))
     x2 = nd.array(x.asnumpy())
@@ -389,26 +396,7 @@ def test_gpt_scan_matches_unstacked():
     eprefix = epre[:-len("layer0_qkv_weight")]
     spre = [n for n in pb if n.endswith("qkv_stack_weight")][0]
     sprefix = spre[:-len("qkv_stack_weight")]
-
-    def stack(name):
-        return nd.array(np.stack(
-            [pa[f"{eprefix}layer{i}_{name}"].data().asnumpy()
-             for i in range(L)]))
-
-    for nm in ("qkv_weight", "qkv_bias", "proj_weight", "proj_bias",
-               "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"):
-        pb[f"{sprefix}{nm.replace('_', '_stack_', 1)}"].set_data(
-            stack(nm))
-    for li, tag in ((0, "ln1"), (1, "ln2")):
-        for wb in ("gamma", "beta"):
-            pb[f"{sprefix}{tag}_stack_{wb}"].set_data(nd.array(np.stack(
-                [pa[f"{eprefix}layer{i}_layernorm{li}_{wb}"]
-                 .data().asnumpy() for i in range(L)])))
-    for wb in ("gamma", "beta"):
-        final = [n for n in pa
-                 if n.startswith(f"{eprefix}layernorm")
-                 and n.endswith(wb)]
-        pb[f"{sprefix}lnf_{wb}"].set_data(pa[final[0]].data())
+    _copy_unstacked_to_scan(pa, pb, eprefix, sprefix, L)
     for nm in ("tok_embed_weight", "pos_embed_weight"):
         src_key = [k for k in pa if k.endswith(nm)][0]
         dst_key = [k for k in pb if k.endswith(nm)][0]
