@@ -84,6 +84,21 @@ class GPTLMLoss(HybridBlock):
         return invoke_simple(_lm_loss_pure, (logits, labels))
 
 
+def _sample(last, temperature, rng):
+    """Pick next tokens from (B, vocab) logits: greedy, or softmax
+    sampling at the given temperature (one home for both decode paths)."""
+    import numpy as np
+
+    if temperature:
+        z = last / temperature
+        z = z - z.max(axis=-1, keepdims=True)
+        p = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+        rng = rng or np.random.default_rng()
+        return np.stack([rng.choice(p.shape[-1], p=row)
+                         for row in p]).astype(np.int32)
+    return last.argmax(axis=-1).astype(np.int32)
+
+
 def generate(model, ids, max_new_tokens=16, temperature=None, rng=None):
     """Greedy (or sampled) decode by full-recompute per step — the
     simple deploy path; ids: (B, T0) NDArray of seed tokens.
@@ -106,18 +121,8 @@ def generate(model, ids, max_new_tokens=16, temperature=None, rng=None):
                 [ctx, np.zeros((ctx.shape[0], W - cur), np.int32)],
                 axis=1)
         logits = model(nd.array(ctx.astype(np.float32))).asnumpy()
-        last = logits[:, cur - 1]
-        if temperature:
-            z = last / temperature
-            z = z - z.max(axis=-1, keepdims=True)
-            p = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
-            rng = rng or np.random.default_rng()
-            nxt = np.stack([rng.choice(p.shape[-1], p=row)
-                            for row in p])
-        else:
-            nxt = last.argmax(axis=-1)
-        out = np.concatenate([out, nxt[:, None].astype(np.int32)],
-                             axis=1)
+        nxt = _sample(logits[:, cur - 1], temperature, rng)
+        out = np.concatenate([out, nxt[:, None]], axis=1)
     return nd.array(out.astype(np.float32))
 
 
@@ -149,3 +154,188 @@ def gpt_tiny(**kwargs):
     kwargs.setdefault("max_length", 64)
     kwargs.setdefault("dropout", 0.0)
     return GPTModel(**kwargs)
+
+
+# -- KV-cache incremental decoding ---------------------------------------------
+#
+# TPU-native inference engine for the decoder-only family: a STATIC
+# (L, B, H, W, Dh) key/value cache updated with dynamic_update_slice at
+# a traced position, so the per-token step is ONE compiled program doing
+# O(W) attention instead of recomputing the O(W²) trunk (the role the
+# reference's inference-time BucketingModule/exec cache plays for RNNs).
+
+
+class CachedDecoder:
+    """Wraps a GPTModel into jitted prefill/step functions.
+
+    Works for scan and unstacked trunks alike: parameters are pulled
+    into (L, ...) stacks once at construction.  ``decode`` mirrors
+    ``generate``'s sampling surface but runs the cached path.
+    """
+
+    def __init__(self, model):
+        self._W = model._max_length
+        params = dict(model.collect_params())
+
+        def get1(suffix):
+            ks = [k for k in params if k.endswith(suffix)]
+            assert len(ks) == 1, (suffix, ks)
+            return params[ks[0]].data()._data
+
+        if any(k.endswith("qkv_stack_weight") for k in params):
+            stacks = {nm: get1(nm) for nm in (
+                "qkv_stack_weight", "qkv_stack_bias",
+                "proj_stack_weight", "proj_stack_bias",
+                "ffn1_stack_weight", "ffn1_stack_bias",
+                "ffn2_stack_weight", "ffn2_stack_bias",
+                "ln1_stack_gamma", "ln1_stack_beta",
+                "ln2_stack_gamma", "ln2_stack_beta")}
+            lnf_g, lnf_b = get1("lnf_gamma"), get1("lnf_beta")
+            num_heads = model.encoder._num_heads
+            act = model.encoder._activation
+        else:
+            enc = model.encoder
+            layers = list(enc.layers._children.values())
+            num_heads = layers[0]._num_heads
+            act = layers[0]._activation
+
+            def stacked(name):
+                import jax.numpy as jnp
+
+                return jnp.stack([
+                    getattr(l, name).data()._data for l in layers])
+
+            stacks = {
+                "qkv_stack_weight": stacked("qkv_weight"),
+                "qkv_stack_bias": stacked("qkv_bias"),
+                "proj_stack_weight": stacked("proj_weight"),
+                "proj_stack_bias": stacked("proj_bias"),
+                "ffn1_stack_weight": stacked("ffn1_weight"),
+                "ffn1_stack_bias": stacked("ffn1_bias"),
+                "ffn2_stack_weight": stacked("ffn2_weight"),
+                "ffn2_stack_bias": stacked("ffn2_bias"),
+            }
+            import jax.numpy as jnp
+
+            stacks["ln1_stack_gamma"] = jnp.stack(
+                [l.ln1.gamma.data()._data for l in layers])
+            stacks["ln1_stack_beta"] = jnp.stack(
+                [l.ln1.beta.data()._data for l in layers])
+            stacks["ln2_stack_gamma"] = jnp.stack(
+                [l.ln2.gamma.data()._data for l in layers])
+            stacks["ln2_stack_beta"] = jnp.stack(
+                [l.ln2.beta.data()._data for l in layers])
+            lnf_g = enc.ln_f.gamma.data()._data
+            lnf_b = enc.ln_f.beta.data()._data
+
+        self._stacks = stacks
+        self._lnf = (lnf_g, lnf_b)
+        self._tok = get1("tok_embed_weight")
+        self._pos = get1("pos_embed_weight")
+        self._H = num_heads
+        self._act = act
+        self._step_fn = None
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ...ops.nn import layer_norm
+
+        H, W = self._H, self._W
+        tok_e, pos_e = self._tok, self._pos
+        lnf_g, lnf_b = self._lnf
+        s = self._stacks
+        C = tok_e.shape[1]
+        Dh = C // H
+        act = self._act
+
+        def step(ck, cv, pos, tok):
+            """ck/cv: (L, B, H, W, Dh); pos: scalar; tok: (B,) int32.
+            Returns (new_ck, new_cv, logits (B, vocab))."""
+            x = jnp.take(tok_e, tok, axis=0) + pos_e[pos]     # (B, C)
+
+            def layer(x, per):
+                (qw, qb, pw, pb, f1w, f1b, f2w, f2b, g1, b1, g2, b2,
+                 ck_l, cv_l) = per
+                h = layer_norm(x, g1, b1)
+                qkv = h @ qw.T + qb                            # (B, 3C)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                B = x.shape[0]
+                qh = q.reshape(B, H, Dh)
+                kh = k.reshape(B, H, Dh)
+                vh = v.reshape(B, H, Dh)
+                ck_l = lax.dynamic_update_slice(
+                    ck_l, kh[:, :, None], (0, 0, pos, 0))
+                cv_l = lax.dynamic_update_slice(
+                    cv_l, vh[:, :, None], (0, 0, pos, 0))
+                scores = jnp.einsum("bhd,bhwd->bhw", qh, ck_l) \
+                    * (Dh ** -0.5)
+                mask = jnp.arange(W) <= pos
+                scores = jnp.where(mask[None, None], scores, -1e30)
+                p = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum("bhw,bhwd->bhd", p, cv_l)
+                attn = attn.reshape(B, C) @ pw.T + pb
+                x = x + attn
+                h = layer_norm(x, g2, b2)
+                h = h @ f1w.T + f1b
+                h = jax.nn.gelu(h) if act == "gelu" \
+                    else jnp.maximum(h, 0)
+                x = x + (h @ f2w.T + f2b)
+                return x, (ck_l, cv_l)
+
+            per_layer = (s["qkv_stack_weight"], s["qkv_stack_bias"],
+                         s["proj_stack_weight"], s["proj_stack_bias"],
+                         s["ffn1_stack_weight"], s["ffn1_stack_bias"],
+                         s["ffn2_stack_weight"], s["ffn2_stack_bias"],
+                         s["ln1_stack_gamma"], s["ln1_stack_beta"],
+                         s["ln2_stack_gamma"], s["ln2_stack_beta"],
+                         ck, cv)
+            x, (ck2, cv2) = lax.scan(layer, x, per_layer)
+            h = layer_norm(x, lnf_g, lnf_b)
+            logits = h @ tok_e.T
+            return ck2, cv2, logits
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    def decode(self, ids, max_new_tokens=16, temperature=None,
+               rng=None):
+        """ids: (B, T0) NDArray seed; returns (B, T0+N) NDArray like
+        generate(), at O(W) per new token.  The cache window is fixed:
+        T0 + max_new_tokens must fit max_length (generate()'s sliding
+        window has no cache to shift, so it has no such bound)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ... import ndarray as nd
+
+        if self._step_fn is None:
+            self._build()
+        out = ids.asnumpy().astype(np.int32)
+        B, T0 = out.shape
+        L = self._stacks["qkv_stack_weight"].shape[0]
+        H, W = self._H, self._W
+        C = self._tok.shape[1]
+        Dh = C // H
+        if T0 + max_new_tokens > W:
+            raise ValueError(
+                f"decode: {T0} seed + {max_new_tokens} new tokens "
+                f"exceed the cache window max_length={W}; use "
+                "generate() for sliding-window decoding")
+        ck = jnp.zeros((L, B, H, W, Dh), self._tok.dtype)
+        cv = jnp.zeros((L, B, H, W, Dh), self._tok.dtype)
+        # prefill: feed seed tokens one by one through the SAME step fn
+        # (one compiled program total; prefill cost O(T0·W))
+        logits = None
+        for t in range(T0):
+            ck, cv, logits = self._step_fn(
+                ck, cv, jnp.asarray(t), jnp.asarray(out[:, t]))
+        for n in range(max_new_tokens):
+            nxt = _sample(np.asarray(logits), temperature, rng)
+            out = np.concatenate([out, nxt[:, None]], axis=1)
+            if n < max_new_tokens - 1:   # last token needs no step
+                ck, cv, logits = self._step_fn(
+                    ck, cv, jnp.asarray(T0 + n), jnp.asarray(nxt))
+        return nd.array(out.astype(np.float32))
