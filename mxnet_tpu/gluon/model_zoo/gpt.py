@@ -339,3 +339,67 @@ class CachedDecoder:
                 ck, cv, logits = self._step_fn(
                     ck, cv, jnp.asarray(T0 + n), jnp.asarray(nxt))
         return nd.array(out.astype(np.float32))
+
+
+# -- pipeline-parallel parts ---------------------------------------------------
+
+class GPTEmbedding(HybridBlock):
+    """Token + position embedding front (pipeline prologue)."""
+
+    def __init__(self, vocab_size, units, max_length, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._dropout = dropout
+        with self.name_scope():
+            self.tok_embed_weight = self.params.get(
+                "tok_embed_weight", shape=(vocab_size, units))
+            self.pos_embed_weight = self.params.get(
+                "pos_embed_weight", shape=(max_length, units))
+            if dropout:
+                self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, ids, tok_embed_weight,
+                       pos_embed_weight):
+        x = F.Embedding(ids, tok_embed_weight,
+                        input_dim=tok_embed_weight.shape[0],
+                        output_dim=self._units)
+        x = x + F.slice_axis(pos_embed_weight, axis=0, begin=0,
+                             end=ids.shape[1])
+        if self._dropout:
+            x = self.drop(x)
+        return x
+
+
+class GPTHead(HybridBlock):
+    """Final LN + LM projection (pipeline epilogue).  UNTIED: the
+    pipeline partitions prologue and epilogue parameters separately, so
+    the single-model weight tying cannot span them."""
+
+    def __init__(self, vocab_size, units, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln_f = nn.LayerNorm(in_channels=units)
+            self.proj = nn.Dense(vocab_size, in_units=units,
+                                 flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return self.proj(self.ln_f(x))
+
+
+def gpt_pipeline_parts(vocab_size=50257, units=768, num_layers=12,
+                       num_heads=12, hidden_size=None, max_length=1024,
+                       dropout=0.0, attention_impl="dense"):
+    """(prologue, trunk stages, epilogue) for parallel.PipelineTrainer:
+    a full causal LM as embedding + homogeneous causal layers + head
+    (mirrors bert_pipeline_parts for the decoder-only family)."""
+    from .bert import TransformerEncoderLayer
+
+    embed = GPTEmbedding(vocab_size, units, max_length, dropout,
+                         prefix="ppgptembed_")
+    layers = [TransformerEncoderLayer(
+        units, num_heads, hidden_size or 4 * units, dropout,
+        attention_impl, causal=True, prefix=f"ppgptlayer{i}_")
+        for i in range(num_layers)]
+    head = GPTHead(vocab_size, units, prefix="ppgpthead_")
+    return embed, layers, head

@@ -1006,3 +1006,58 @@ def test_bert_ring_attention_sharded_training():
         assert losses[-1] < losses[0], losses
     finally:
         parallel.set_default_mesh(None)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_gpt_matches_grad_accum(schedule):
+    """The decoder-only family pipelines under BOTH schedules: causal
+    trunk stages + embedding prologue + LM head epilogue vs the
+    unpipelined grad_accum oracle."""
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    def build():
+        mx.random.seed(13)
+        np.random.seed(13)
+        embed, layers, head = gpt.gpt_pipeline_parts(
+            vocab_size=64, units=16, num_layers=2, num_heads=2,
+            max_length=16, dropout=0.0)
+        for b in [embed] + layers + [head]:
+            b.initialize(init=mx.init.Xavier())
+        return embed, layers, head
+
+    opt, opt_kw = "sgd", {"learning_rate": 0.05, "momentum": 0.9}
+    embed, layers, head = build()
+    mesh = parallel.make_mesh(pp=2)
+    pt = parallel.PipelineTrainer(
+        layers, gpt.GPTLMLoss(), opt, opt_kw, mesh=mesh,
+        n_microbatches=4, prologue=embed, epilogue=head,
+        schedule=schedule)
+
+    embed2, layers2, head2 = build()
+    seq = gluon.nn.HybridSequential(prefix="gptref_")
+    seq.add(embed2)
+    for l in layers2:
+        seq.add(l)
+    seq.add(head2)
+    ref = parallel.ShardedTrainer(
+        seq, gpt.GPTLMLoss(), opt, dict(opt_kw),
+        mesh=parallel.data_parallel_mesh(1), grad_accum=4)
+
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    for _ in range(3):
+        lp = float(pt.step(mx.nd.array(ids),
+                           mx.nd.array(ids)).asscalar())
+        lr_ = float(ref.step(jnp.asarray(ids),
+                             jnp.asarray(ids)).asscalar())
+    np.testing.assert_allclose(lp, lr_, rtol=1e-5)
+    pt.sync_params()
+    ref.sync_params()
+    pp_params = {}
+    for block in [embed] + layers + [head]:
+        pp_params.update(block.collect_params())
+    for (n1, p1), (n2, p2) in zip(pp_params.items(),
+                                  seq.collect_params().items()):
+        np.testing.assert_allclose(p1.data().asnumpy(),
+                                   p2.data().asnumpy(), rtol=2e-5,
+                                   atol=2e-6, err_msg=f"{n1} vs {n2}")
