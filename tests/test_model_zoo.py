@@ -423,3 +423,28 @@ def test_gpt_cached_decoder_matches_recompute():
         dec = gpt.CachedDecoder(net).decode(
             ids, max_new_tokens=5).asnumpy()
         np.testing.assert_array_equal(ref, dec, err_msg=f"scan={scan}")
+
+
+def test_gpt_flash_attention_trains():
+    """The causal LM with attention_impl='flash' (interpret mode on
+    CPU): the Pallas causal kernel inside the full training step."""
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    net = gpt.gpt_tiny(attention_impl="flash", scan_layers=True)
+    net.initialize(init=mx.init.Xavier())
+    loss_fn = gpt.GPTLMLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    rs = np.random.RandomState(0)
+    seq = (np.cumsum(np.ones((4, 32)), axis=1)
+           + rs.randint(0, 16, (4, 1))) % 16
+    ids = nd.array(seq.astype(np.float32))
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(net(ids), ids)
+        loss.backward()
+        tr.step(4)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
