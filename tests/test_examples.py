@@ -73,3 +73,10 @@ def test_example_sparse_linear_libsvm():
 def test_example_gpt_char_lm():
     out = _run("gpt_char_lm.py", "--steps", "120", timeout=500)
     assert "char-LM OK" in out
+
+
+def test_example_gpt_pretrain_sharded():
+    out = _run("gpt_pretrain_sharded.py", "--model", "gpt_tiny",
+               "--steps", "12", "--batch-size", "8", "--seq-len", "32",
+               "--tp", "2", timeout=500)
+    assert "GPT sharded pretrain OK" in out

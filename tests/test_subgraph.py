@@ -171,3 +171,32 @@ def test_hybridblock_optimize_for():
     ops = [n.op for n in opt._outputs_sym._topo() if n.op]
     assert "_subgraph_exec" in ops, ops
     np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_optimize_for_multi_input_block():
+    """optimize_for derives ordered input names from the trace, so a
+    TWO-input HybridBlock partitions and rebinds correctly (the old
+    hard-coded single var('data') mis-bound it)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    class TwoIn(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, a, b):
+            return self.fc(a) + self.fc(b) * 2.0
+
+    net = TwoIn()
+    net.initialize(init=mx.init.Xavier())
+    rs = np.random.RandomState(0)
+    a = mx.nd.array(rs.randn(2, 3).astype("float32"))
+    b = mx.nd.array(rs.randn(2, 3).astype("float32"))
+    ref = net(a, b).asnumpy()
+    sb = net.optimize_for(a, "XLA", b)
+    np.testing.assert_allclose(sb(a, b).asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
