@@ -406,6 +406,12 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
+            # remember the seen input shapes: the export trace attaches
+            # them to its Variables so hybrid_forward code reading
+            # x.shape keeps working symbolically
+            self._last_input_shapes = [
+                tuple(a.shape) if hasattr(a, "shape") else None
+                for a in (x,) + args]
             if self._active and not _TRACE.force_eager:
                 return self._call_cached_op(x, *args)
             return self._eager_forward(x, *args)

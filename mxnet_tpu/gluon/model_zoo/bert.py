@@ -242,7 +242,10 @@ class BERTModel(HybridBlock):
         if token_types is not None:
             x = x + F.Embedding(token_types, token_type_embed_weight)
         else:
-            x = x + token_type_embed_weight[0]
+            # [0:1] not [0]: a slice broadcasts identically eagerly AND
+            # traces as array indexing (bare ints mean output views on
+            # Symbols)
+            x = x + token_type_embed_weight[0:1]
         x = x + position_embed_weight[:T]
         x = self.embed_ln(x)
         if self._dropout:
@@ -343,7 +346,7 @@ class BERTEmbedding(HybridBlock):
                        position_embed_weight=None):
         T = inputs.shape[1]
         x = F.Embedding(inputs, word_embed_weight)
-        x = x + token_type_embed_weight[0]
+        x = x + token_type_embed_weight[0:1]  # slice: trace-safe
         x = x + position_embed_weight[:T]
         x = self.embed_ln(x)
         if self._dropout:

@@ -31,6 +31,7 @@ class GPTModel(HybridBlock):
                  remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._vocab = vocab_size
         self._max_length = max_length
         self._dropout = dropout
         with self.name_scope():
@@ -52,8 +53,8 @@ class GPTModel(HybridBlock):
 
     def hybrid_forward(self, F, ids, tok_embed_weight,
                        pos_embed_weight):
-        x = F.Embedding(ids, tok_embed_weight,
-                        input_dim=tok_embed_weight.shape[0],
+        # stored sizes keep the op attrs static ints under the trace
+        x = F.Embedding(ids, tok_embed_weight, input_dim=self._vocab,
                         output_dim=self._units)
         T = ids.shape[1]
         x = x + F.slice_axis(pos_embed_weight, axis=0, begin=0, end=T)
@@ -61,9 +62,10 @@ class GPTModel(HybridBlock):
             x = self.drop(x)
         h = self.encoder(x)                       # (B, T, C)
         # tied head: logits = h @ embedᵀ — one big MXU matmul
-        return F.dot(F.reshape(h, (-1, self._units)), tok_embed_weight,
-                     transpose_b=True).reshape(
-            (ids.shape[0], T, tok_embed_weight.shape[0]))
+        # (kwarg shape= so the symbolic trace maps it as an attribute)
+        flat = F.reshape(h, shape=(-1, self._units))
+        logits = F.dot(flat, tok_embed_weight, transpose_b=True)
+        return F.reshape(logits, shape=(-1, T, self._vocab))
 
 
 def _lm_loss_pure(logits, labels):
@@ -350,6 +352,7 @@ class GPTEmbedding(HybridBlock):
                  **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._vocab = vocab_size
         self._dropout = dropout
         with self.name_scope():
             self.tok_embed_weight = self.params.get(
@@ -361,8 +364,7 @@ class GPTEmbedding(HybridBlock):
 
     def hybrid_forward(self, F, ids, tok_embed_weight,
                        pos_embed_weight):
-        x = F.Embedding(ids, tok_embed_weight,
-                        input_dim=tok_embed_weight.shape[0],
+        x = F.Embedding(ids, tok_embed_weight, input_dim=self._vocab,
                         output_dim=self._units)
         x = x + F.slice_axis(pos_embed_weight, axis=0, begin=0,
                              end=ids.shape[1])

@@ -285,3 +285,23 @@ def space_to_depth(data, block_size=2):
     x = data.reshape(n, c, h // b, b, w // b, b)
     x = x.transpose(0, 3, 5, 1, 2, 4)
     return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("_sym_index")
+def _sym_index(data, index_spec=None):
+    """Decode the JSON index spec Symbol.__getitem__ encodes (symbolic
+    array indexing: pos_table[:T], seq[:, 0, :], ...)."""
+    import builtins  # the registered `slice` op shadows the builtin
+
+    idx = []
+    for item in index_spec or []:
+        tag = item[0]
+        if tag == "i":
+            idx.append(int(item[1]))
+        elif tag == "s":
+            idx.append(builtins.slice(item[1], item[2], item[3]))
+        elif tag == "e":
+            idx.append(Ellipsis)
+        else:
+            idx.append(None)
+    return data[tuple(idx)]

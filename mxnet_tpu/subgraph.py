@@ -291,8 +291,12 @@ def subgraph_exec(node, ext_vals):
                             if isinstance(slot, (tuple, list)) else slot
                     ins.append(v)
                 opdef = _registry.get(m.op)
-                kwargs = {k: v for k, v in m.attrs.items()
+                from .symbol.symbol import _split_kw_inputs
+
+                ins, kw_bound, attrs_nk = _split_kw_inputs(ins, m.attrs)
+                kwargs = {k: v for k, v in attrs_nk.items()
                           if not k.startswith("__")}
+                kwargs.update(kw_bound)
                 if opdef.mode_dependent \
                         and kwargs.get("_is_training") is None:
                     kwargs["_is_training"] = training

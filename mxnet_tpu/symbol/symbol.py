@@ -55,21 +55,40 @@ class Symbol:
     def attr(self, key):
         return self._attr_dict.get(key)
 
+    @property
+    def shape(self):
+        """Static shape when known (Variables created from Parameters
+        or shaped trace inputs); models read e.g. ``ids.shape[1]`` in
+        hybrid_forward, and the export trace must serve it."""
+        s = self._attr_dict.get("shape")
+        if s is None:
+            raise AttributeError(
+                f"Symbol {self.name!r} has no static shape (create the "
+                "Variable with shape=, or export after a forward pass "
+                "so trace inputs carry the seen shapes)")
+        return tuple(s)
+
     def _set_attr(self, **kwargs):
         self._attr_dict.update(kwargs)
 
     def __getitem__(self, index):
-        if isinstance(index, int):
-            if self._n_outputs == 1 and index == 0:
-                return self
-            view = Symbol(self.op, self.name, self.inputs, self.attrs,
-                          out_index=index, n_outputs=self._n_outputs)
-            # attrs are NODE-level (eval caches by name): views share
-            # the dict so e.g. a partitioned region's carried state is
-            # reachable through any output view
-            view._attr_dict = self._attr_dict
-            return view
-        raise MXNetError("Symbol only supports integer indexing")
+        if not isinstance(index, int):
+            # array indexing (slices/tuples, e.g. pos_table[:T] or
+            # seq[:, 0, :]) becomes a graph node with a JSON-able spec.
+            # INT indexing keeps its historical output-view meaning
+            # (loaded multi-output graphs depend on it) — use [i:i+1] /
+            # slice_axis for row selection.
+            return apply_op("_sym_index", self,
+                            index_spec=_encode_index(index))
+        if self._n_outputs == 1 and index == 0:
+            return self
+        view = Symbol(self.op, self.name, self.inputs, self.attrs,
+                      out_index=index, n_outputs=self._n_outputs)
+        # attrs are NODE-level (eval caches by name): views share
+        # the dict so e.g. a partitioned region's carried state is
+        # reachable through any output view
+        view._attr_dict = self._attr_dict
+        return view
 
     # arithmetic via registered broadcast ops
     def _binop(self, other, opname, reverse=False):
@@ -197,7 +216,7 @@ class Symbol:
         else:
             args = self._eval_inputs(node, env, cache)
             opdef = _registry.get(node.op)
-            kwargs = dict(node.attrs)
+            pos, kw_bound, kwargs = _split_kw_inputs(args, node.attrs)
             kwargs.pop("__aux__", None)
             # same execution-scope injection the ndarray invoke wrapper
             # does: mode from the autograd scope, PRNG from the key scope
@@ -209,7 +228,7 @@ class Symbol:
                 from ..random import next_key
 
                 kwargs["_key"] = next_key()
-            val = opdef.fn(*args, **kwargs)
+            val = opdef.fn(*pos, **kw_bound, **kwargs)
         cache[key] = val
         return val
 
@@ -221,12 +240,15 @@ class Symbol:
         return out
 
     def eval(self, ctx=None, **kwargs):
-        """Reference: Symbol.eval — bind variables, return NDArray(s)."""
+        """Reference: Symbol.eval — bind variables, return NDArray(s);
+        multi-output (Group) evals return a list, one per output."""
         from ..ndarray.ndarray import NDArray, _from_jax
 
         env = {k: (v._data if isinstance(v, NDArray) else v)
                for k, v in kwargs.items()}
         out = self.eval_raw(**env)
+        if isinstance(out, tuple):
+            return [_from_jax(o) for o in out]
         return _from_jax(out)
 
     def infer_shape(self, **kwargs):
@@ -286,9 +308,12 @@ class Symbol:
                      for s in in_shapes]
             opdef = _registry.get(node.op)
             try:
-                out = jax.eval_shape(
-                    lambda *a, _f=opdef.fn, _kw=node.attrs: _f(*a, **_kw),
-                    *specs)
+                def _call(*a, _f=opdef.fn, _attrs=node.attrs):
+                    pos, kw_bound, kw = _split_kw_inputs(a, _attrs)
+                    kw.pop("__aux__", None)
+                    return _f(*pos, **kw_bound, **kw)
+
+                out = jax.eval_shape(_call, *specs)
             except Exception:
                 shapes[node.name] = None
                 continue
@@ -502,6 +527,50 @@ def _scalar_sym(value):
     return s
 
 
+def _encode_index(index):
+    """NDArray-style index → JSON-able spec (decoded by ops._sym_index)."""
+    items = index if isinstance(index, tuple) else (index,)
+    spec = []
+    for it in items:
+        if isinstance(it, int):
+            spec.append(["i", it])
+        elif isinstance(it, slice):
+            parts = []
+            for b in (it.start, it.stop, it.step):
+                if b is None:
+                    parts.append(None)
+                elif isinstance(b, (int,)) or (
+                        hasattr(b, "__index__")
+                        and not isinstance(b, Symbol)):
+                    parts.append(int(b))
+                else:
+                    raise MXNetError(
+                        "Symbol slice bounds must be static ints "
+                        f"(got {type(b).__name__}); dynamic bounds "
+                        "need slice_axis with a concrete end")
+            spec.append(["s"] + parts)
+        elif it is Ellipsis:
+            spec.append(["e"])
+        elif it is None:
+            spec.append(["n"])
+        else:
+            raise MXNetError(
+                f"Symbol indexing supports ints/slices/Ellipsis/None, "
+                f"got {type(it).__name__}")
+    return spec
+
+
+def _split_kw_inputs(args, attrs):
+    """Undo apply_op's kwarg lifting: (positional args, kw-bound tensor
+    args, remaining attrs)."""
+    attrs = dict(attrs)
+    kw_names = attrs.pop("__kw_inputs__", None)
+    if kw_names:
+        n = len(kw_names)
+        return list(args[:-n]), dict(zip(kw_names, args[-n:])), attrs
+    return list(args), {}, attrs
+
+
 def _null_sym():
     s = var(_auto_name("null"))
     s._set_attr(__null__=True)
@@ -526,6 +595,16 @@ def apply_op(opname, *sym_inputs, name=None, **kwargs):
     # scalar-constant variables so positions stay aligned at eval
     inputs = [i if isinstance(i, Symbol) else _scalar_sym(i)
               for i in inputs]
+    # Symbol-valued KWARGS (e.g. multi_head_attention(qkv_weight=w))
+    # are tensor inputs, not attributes: lift them to the inputs list
+    # and record their names so eval rebinds them (__kw_inputs__ is a
+    # plain string list — JSON round-trips through symbol.json)
+    kw_syms = [(k, v) for k, v in kwargs.items() if isinstance(v, Symbol)]
+    if kw_syms:
+        for k, _ in kw_syms:
+            kwargs.pop(k)
+        kwargs["__kw_inputs__"] = [k for k, _ in kw_syms]
+        inputs += [v for _, v in kw_syms]
     # multi-output ops: reflected lazily when indexing
     return Symbol(opname, nm, inputs, kwargs)
 
@@ -557,6 +636,12 @@ def fromjson(data):
             if attrs.get("__aux__"):
                 v._set_attr(__aux__=True)
             built.append(v)
+        elif nd["op"] == "_group":
+            # rebuild as a real Group: keeps multi-output count and the
+            # specialized per-output eval
+            built.append(Group(
+                [built[i][oi] if oi else built[i]
+                 for i, oi, _ in nd["inputs"]]))
         else:
             inputs = [built[i][oi] for i, oi, _ in nd["inputs"]]
             sym = apply_op(nd["op"], *inputs, name=nd["name"], **attrs)
@@ -577,12 +662,17 @@ def trace_block(block, inputs=None):
     """
     from .. import autograd as _ag
 
+    shapes = getattr(block, "_last_input_shapes", None) or []
     if inputs is None:
-        inputs = [var("data")]
+        names = ["data"] if len(shapes) <= 1 else [
+            f"data{i}" for i in range(len(shapes))]
+        inputs = [var(n, shape=s)
+                  for n, s in zip(names, shapes)] or [var("data")]
     elif isinstance(inputs, str):
-        inputs = [var(inputs)]
+        inputs = [var(inputs, shape=shapes[0] if shapes else None)]
     elif all(isinstance(i, str) for i in inputs):
-        inputs = [var(i) for i in inputs]
+        inputs = [var(n, shape=s) for n, s in zip(
+            inputs, list(shapes) + [None] * len(inputs))]
     with _ag.predict_mode(), _ag.pause():
         out = block(*inputs)
     if isinstance(out, (list, tuple)):
