@@ -86,6 +86,22 @@ class GPTLMLoss(HybridBlock):
         return invoke_simple(_lm_loss_pure, (logits, labels))
 
 
+def _windowed_last_logits(model, flat, nd_mod, np_mod):
+    """Last-position logits for (N, T) token rows through the model's
+    fixed max_length window: right-pad to W (one compiled shape — causal
+    masking hides the pad) and read position cur-1.  Shared by
+    generate() and beam_generate()."""
+    W = model._max_length
+    ctx = flat[:, -W:]
+    cur = ctx.shape[1]
+    if cur < W:
+        ctx = np_mod.concatenate(
+            [ctx, np_mod.zeros((ctx.shape[0], W - cur), np_mod.int32)],
+            axis=1)
+    logits = model(nd_mod.array(ctx.astype(np_mod.float32))).asnumpy()
+    return logits[:, cur - 1]
+
+
 def _sample(last, temperature, rng):
     """Pick next tokens from (B, vocab) logits: greedy, or softmax
     sampling at the given temperature (one home for both decode paths)."""
@@ -114,16 +130,9 @@ def generate(model, ids, max_new_tokens=16, temperature=None, rng=None):
     from ... import ndarray as nd
 
     out = ids.asnumpy().astype(np.int32)
-    W = model._max_length
     for _ in range(max_new_tokens):
-        ctx = out[:, -W:]
-        cur = ctx.shape[1]
-        if cur < W:
-            ctx = np.concatenate(
-                [ctx, np.zeros((ctx.shape[0], W - cur), np.int32)],
-                axis=1)
-        logits = model(nd.array(ctx.astype(np.float32))).asnumpy()
-        nxt = _sample(logits[:, cur - 1], temperature, rng)
+        last = _windowed_last_logits(model, out, nd, np)
+        nxt = _sample(last, temperature, rng)
         out = np.concatenate([out, nxt[:, None]], axis=1)
     return nd.array(out.astype(np.float32))
 
@@ -405,3 +414,27 @@ def gpt_pipeline_parts(vocab_size=50257, units=768, num_layers=12,
         for i in range(num_layers)]
     head = GPTHead(vocab_size, units, prefix="ppgpthead_")
     return embed, layers, head
+
+
+def beam_generate(model, ids, max_new_tokens=16, beam_size=4,
+                  eos_id=None, alpha=0.6):
+    """Beam-search continuation of a shared prompt (decoder-only analog
+    of transformer.beam_search, same ``beam_loop`` core and GNMT length
+    penalty).  ids: (B, T0) NDArray seed; returns
+    (tokens (B, T0+N), scores (B,))."""
+    import numpy as np
+
+    from ... import autograd
+    from ... import ndarray as nd
+    from .transformer import beam_loop
+
+    seed = ids.asnumpy().astype(np.int32)
+    B = seed.shape[0]
+
+    def score_last(flat):
+        with autograd.predict_mode():
+            return _windowed_last_logits(model, flat, nd, np)
+
+    out, scores = beam_loop(score_last, B, beam_size, None, eos_id,
+                            max_new_tokens, alpha, seed_beams=seed)
+    return nd.array(out.astype(np.float32)), scores

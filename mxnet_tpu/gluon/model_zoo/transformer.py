@@ -233,45 +233,46 @@ def transformer_tiny(src_vocab, tgt_vocab, **kwargs):
                        num_heads=2, hidden_size=64, **kwargs)
 
 
-def beam_search(model, src_tokens, bos_id, eos_id, beam_size=4,
-                max_len=64, alpha=0.6):
-    """Length-normalized beam search (reference analog: sockeye's
-    inference; length penalty ((5+|Y|)/6)^alpha from GNMT).
+def beam_loop(score_last_fn, B, beam_size, init_token, eos_id,
+              max_steps, alpha=0.6, seed_beams=None):
+    """Generic length-normalized beam search core (GNMT length penalty).
 
-    Host-driven loop; each scoring step is one batched forward over
-    B*beam hypotheses.  Returns (tokens (B, <=max_len), scores (B,)).
+    ``score_last_fn(flat_tokens (B·K, T)) -> (B·K, V)`` returns the
+    LAST-position logits for each hypothesis.  Seeds either from a
+    single ``init_token`` (encoder-decoder: BOS) or ``seed_beams``
+    (B, T0) — a shared prompt per batch row (decoder-only LMs).  Both
+    the NMT ``beam_search`` wrapper and ``gpt.beam_generate`` drive
+    this one loop.  Returns (tokens (B, T), normalized scores (B,)).
     """
     import numpy as np
 
-    from ... import autograd
-    from ... import ndarray as nd
-
-    B = src_tokens.shape[0]
     K = beam_size
-    src_np = src_tokens.asnumpy() if hasattr(src_tokens, "asnumpy") \
-        else np.asarray(src_tokens)
-    # tile sources per beam: (B*K, S)
-    src_rep = nd.array(np.repeat(src_np, K, axis=0))
-
-    beams = np.full((B, K, 1), bos_id, np.int32)
+    if seed_beams is not None:
+        beams = np.repeat(seed_beams[:, None, :], K, axis=1) \
+            .astype(np.int32)
+    else:
+        beams = np.full((B, K, 1), init_token, np.int32)
+    seed_len = beams.shape[2]
     scores = np.full((B, K), -1e9, np.float32)
     scores[:, 0] = 0.0  # only the first beam is live initially
-    finished = np.zeros((B, K), bool)
+    # a prompt already ending in EOS starts finished (free-EOS padding)
+    finished = (beams[:, :, -1] == eos_id) if eos_id is not None \
+        else np.zeros((B, K), bool)
 
-    for _ in range(max_len - 1):
+    for _ in range(max_steps):
         flat = beams.reshape(B * K, -1)
-        with autograd.predict_mode():
-            logits = model(src_rep, nd.array(flat.astype("float32")))
-        logp = logits.asnumpy()[:, -1]
+        logp = score_last_fn(flat)
         logp = logp - _logsumexp(logp)  # normalize to log-probs
         V = logp.shape[-1]
         logp = logp.reshape(B, K, V)
-        # finished beams only extend with EOS at no cost
-        logp_ext = np.where(
-            finished[:, :, None],
-            np.where(np.arange(V)[None, None, :] == eos_id, 0.0, -1e9),
-            logp)
-        total = scores[:, :, None] + logp_ext           # (B, K, V)
+        if eos_id is not None:
+            # finished beams only extend with EOS at no cost
+            logp = np.where(
+                finished[:, :, None],
+                np.where(np.arange(V)[None, None, :] == eos_id, 0.0,
+                         -1e9),
+                logp)
+        total = scores[:, :, None] + logp               # (B, K, V)
         flat_total = total.reshape(B, K * V)
         top = np.argsort(-flat_total, axis=1)[:, :K]     # (B, K)
         new_scores = np.take_along_axis(flat_total, top, axis=1)
@@ -280,19 +281,55 @@ def beam_search(model, src_tokens, bos_id, eos_id, beam_size=4,
         beams = np.concatenate(
             [np.take_along_axis(beams, src_beam[:, :, None], axis=1),
              tok[:, :, None]], axis=2)
-        finished = np.take_along_axis(finished, src_beam, axis=1) \
-            | (tok == eos_id)
+        if eos_id is not None:
+            finished = np.take_along_axis(finished, src_beam, axis=1) \
+                | (tok == eos_id)
         scores = new_scores
-        if finished.all():
+        if eos_id is not None and finished.all():
             break
 
-    # GNMT length penalty on the FINAL scores
-    lengths = (beams != eos_id).sum(axis=2).astype(np.float32)
+    # GNMT length penalty on the FINAL scores — over GENERATED tokens
+    # only (scores hold no seed-token log-probs, so counting the prompt
+    # would neutralize the normalization for long prompts)
+    gen = beams[:, :, seed_len:]
+    if eos_id is not None:
+        lengths = (gen != eos_id).sum(axis=2).astype(np.float32)
+    else:
+        lengths = np.full((B, K), gen.shape[2], np.float32)
     lp = ((5.0 + lengths) / 6.0) ** alpha
     normed = scores / lp
     best = normed.argmax(axis=1)
     out = beams[np.arange(B), best]
     return out, normed[np.arange(B), best]
+
+
+def beam_search(model, src_tokens, bos_id, eos_id, beam_size=4,
+                max_len=64, alpha=0.6):
+    """Length-normalized beam search (reference analog: sockeye's
+    inference; length penalty ((5+|Y|)/6)^alpha from GNMT).
+
+    Host-driven loop over ``beam_loop``; each scoring step is one
+    batched forward over B·beam hypotheses.  Returns
+    (tokens (B, <=max_len), scores (B,)).
+    """
+    import numpy as np
+
+    from ... import autograd
+    from ... import ndarray as nd
+
+    B = src_tokens.shape[0]
+    src_np = src_tokens.asnumpy() if hasattr(src_tokens, "asnumpy") \
+        else np.asarray(src_tokens)
+    # tile sources per beam: (B*K, S)
+    src_rep = nd.array(np.repeat(src_np, beam_size, axis=0))
+
+    def score_last(flat):
+        with autograd.predict_mode():
+            logits = model(src_rep, nd.array(flat.astype("float32")))
+        return logits.asnumpy()[:, -1]
+
+    return beam_loop(score_last, B, beam_size, bos_id, eos_id,
+                     max_len - 1, alpha)
 
 
 def _logsumexp(a):
