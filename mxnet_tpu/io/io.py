@@ -761,9 +761,14 @@ class LibSVMIter(DataIter):
         lshape = label_shape or (1,)
         if not isinstance(lshape, (tuple, list)):
             lshape = (lshape,)
-        self.provide_label = [DataDesc(
-            "softmax_label", (batch_size,) + tuple(
-                s for s in lshape if s != 1))]
+        if any(s > 1 for s in lshape):
+            # the parser reads exactly one label per row; advertising a
+            # wider shape would lie to bind-time shape inference
+            raise MXNetError(
+                f"LibSVMIter: label_shape {tuple(lshape)} unsupported — "
+                "label_libsvm multi-label input is not implemented; one "
+                "label per row only")
+        self.provide_label = [DataDesc("softmax_label", (batch_size,))]
 
     def reset(self):
         self._cursor = 0

@@ -18,6 +18,7 @@ import sys
 
 from .. import autograd as _ag
 from .. import profiler as _prof
+from ..base import MXNetError as _MXNetError
 from ..ops import registry as _registry
 from ..ops.registry import OpDef
 from .ndarray import NDArray, _from_jax
@@ -69,11 +70,15 @@ def _stype_dispatch(opdef, args, kwargs):
         from .sparse import dot as sparse_dot
 
         if isinstance(args[0], CSRNDArray):
-            return sparse_dot(args[0], args[1],
-                              transpose_a=kwargs.get("transpose_a",
-                                                     False),
-                              transpose_b=kwargs.get("transpose_b",
-                                                     False))
+            # transpose flags may arrive positionally (dot(lhs, rhs,
+            # transpose_a, transpose_b) — same order as the dense op)
+            extras = args[2:4]
+            ta = extras[0] if len(extras) > 0 else kwargs.get(
+                "transpose_a", False)
+            tb = extras[1] if len(extras) > 1 else kwargs.get(
+                "transpose_b", False)
+            return sparse_dot(args[0], args[1], transpose_a=ta,
+                              transpose_b=tb)
     elif opdef.name.lower() == "cast_storage":
         from .sparse import cast_storage as sparse_cast
 
@@ -99,11 +104,19 @@ def _stype_dispatch(opdef, args, kwargs):
 def invoke(opdef: OpDef, args: tuple, kwargs: dict):
     # frontend-only kwargs accepted by every reference op wrapper
     out_arr = kwargs.pop("out", None)
-    sparse_out = _stype_dispatch(opdef, args, kwargs)
-    if sparse_out is not None:
-        return sparse_out
     req_ctx = kwargs.pop("ctx", None)
     name = kwargs.pop("name", None)  # symbol-compat: ignored eagerly
+    sparse_out = _stype_dispatch(opdef, args, kwargs)
+    if sparse_out is not None:
+        if out_arr is not None or req_ctx is not None:
+            from .sparse import BaseSparseNDArray
+
+            if isinstance(sparse_out, BaseSparseNDArray):
+                raise _MXNetError(
+                    f"{opdef.name}: out=/ctx= unsupported when the "
+                    "result has sparse storage")
+            return _finalize(sparse_out, out_arr, req_ctx)
+        return sparse_out
     kwargs = _inject(opdef, kwargs)
     fn = opdef.fn
     if _prof._S.running:  # cheap flag read on the hot path

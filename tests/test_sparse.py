@@ -375,6 +375,54 @@ def test_csr_dot_dispatch_covers_all_entry_points():
         nd.sparse.dot(a_csr, nd.array(w[:5]))
 
 
+def test_csr_dot_positional_transpose_and_out():
+    """ADVICE r4: positional transpose flags must reach the CSR kernel
+    (nd.dot(csr, x, True) — valid reference API), and out= must either
+    be honored (dense result) or raise (sparse result), never be
+    silently left stale."""
+    rs = np.random.RandomState(11)
+    a = ((rs.rand(6, 9) < 0.4) *
+         rs.standard_normal((6, 9))).astype(np.float32)
+    x = rs.standard_normal((6, 3)).astype(np.float32)
+    a_csr = csr_matrix(a)
+    x_nd = nd.array(x)
+    # positional transpose_a (third positional arg, dense-op order)
+    np.testing.assert_allclose(nd.dot(a_csr, x_nd, True).asnumpy(),
+                               a.T @ x, rtol=1e-5, atol=1e-5)
+    # out= with a dense result is written through
+    w_nd = nd.array(rs.standard_normal((9, 3)).astype(np.float32))
+    buf = nd.zeros((6, 3))
+    got = nd.dot(a_csr, w_nd, out=buf)
+    assert got is buf
+    np.testing.assert_allclose(buf.asnumpy(),
+                               a @ w_nd.asnumpy(), rtol=1e-5, atol=1e-5)
+    # out= with a sparse result raises instead of going stale
+    d_nd = nd.array(a)
+    with pytest.raises(Exception, match="sparse storage"):
+        nd.cast_storage(d_nd, "csr", out=nd.zeros((6, 9)))
+
+
+def test_libsvm_iter_rejects_multilabel_shape():
+    """ADVICE r4: the parser reads one label per row, so a wider
+    label_shape must be rejected up front rather than advertising a
+    provide_label descriptor the batches never match."""
+    import os
+    import tempfile
+
+    from mxnet_tpu import io
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "t.libsvm")
+        with open(path, "w") as f:
+            f.write("1 0:1.5\n")
+        with pytest.raises(Exception, match="label_shape"):
+            io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                          batch_size=1, label_shape=(3,))
+        it = io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                           batch_size=1, label_shape=1)
+        assert it.provide_label[0].shape == (1,)
+
+
 def test_cast_storage_preserves_dtype():
     # int32 survives jnp.asarray (f64 would be downcast at nd.array
     # already, before cast_storage is involved); nd.array defaults to
