@@ -80,3 +80,9 @@ def test_example_gpt_pretrain_sharded():
                "--steps", "12", "--batch-size", "8", "--seq-len", "32",
                "--tp", "2", timeout=500)
     assert "GPT sharded pretrain OK" in out
+
+
+def test_example_train_ssd():
+    out = _run("train_ssd.py", "--steps", "12", "--batch-size", "4",
+               "--size", "64", timeout=500)
+    assert "ssd training OK" in out
