@@ -182,10 +182,17 @@ class CachedDecoder:
     Works for scan and unstacked trunks alike: parameters are pulled
     into (L, ...) stacks once at construction.  ``decode`` mirrors
     ``generate``'s sampling surface but runs the cached path.
+
+    Pass ``mesh=`` (with a ``tp_axis`` mesh axis) for tensor-parallel
+    serving: heads, the KV cache, and the FFN hidden dim shard over the
+    axis (Megatron column/row rules) and GSPMD inserts the two
+    per-layer all-reduces — multi-chip decode with no code change.
     """
 
-    def __init__(self, model):
+    def __init__(self, model, mesh=None, tp_axis="tp"):
         self._W = model._max_length
+        self._mesh = mesh
+        self._tp_axis = tp_axis
         params = dict(model.collect_params())
 
         def get1(suffix):
@@ -247,6 +254,17 @@ class CachedDecoder:
         self._act = act
         self._step_fn = None
 
+    def _shard(self, arr, spec):
+        """Place with a NamedSharding when a tp mesh is set (GSPMD then
+        propagates the layout and inserts the collectives); no-op on the
+        single-device path."""
+        if self._mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(self._mesh, P(*spec)))
+
     def _build(self):
         import jax
         import jax.numpy as jnp
@@ -261,6 +279,31 @@ class CachedDecoder:
         C = tok_e.shape[1]
         Dh = C // H
         act = self._act
+        L = s["qkv_stack_weight"].shape[0]
+        F = s["ffn1_stack_weight"].shape[1]
+        tp = self._tp_axis
+        if self._mesh is not None:
+            n_tp = self._mesh.shape[tp]
+            if H % n_tp or F % n_tp:
+                raise ValueError(
+                    f"CachedDecoder: tp axis size {n_tp} must divide "
+                    f"both num_heads={H} and ffn hidden={F}")
+
+        # Head-/hidden-major restructuring so a tp mesh shards the H and
+        # F dims (Megatron rules: column-parallel qkv/ffn1, row-parallel
+        # proj/ffn2 — the contraction over a sharded dim becomes XLA's
+        # all-reduce).  Single-device runs the same code unsharded.
+        qkvw = self._shard(
+            s["qkv_stack_weight"].reshape(L, 3, H, Dh, C),
+            (None, None, tp))
+        qkvb = self._shard(s["qkv_stack_bias"].reshape(L, 3, H, Dh),
+                           (None, None, tp))
+        pwh = self._shard(s["proj_stack_weight"].reshape(L, C, H, Dh),
+                          (None, None, tp))
+        f1w = self._shard(s["ffn1_stack_weight"], (None, tp))
+        f1b = self._shard(s["ffn1_stack_bias"], (None, tp))
+        f2w = self._shard(s["ffn2_stack_weight"], (None, None, tp))
+        pb, f2b = s["proj_stack_bias"], s["ffn2_stack_bias"]
 
         def step(ck, cv, pos, tok):
             """ck/cv: (L, B, H, W, Dh); pos: scalar; tok: (B,) int32.
@@ -271,12 +314,8 @@ class CachedDecoder:
                 (qw, qb, pw, pb, f1w, f1b, f2w, f2b, g1, b1, g2, b2,
                  ck_l, cv_l) = per
                 h = layer_norm(x, g1, b1)
-                qkv = h @ qw.T + qb                            # (B, 3C)
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                B = x.shape[0]
-                qh = q.reshape(B, H, Dh)
-                kh = k.reshape(B, H, Dh)
-                vh = v.reshape(B, H, Dh)
+                qkv = jnp.einsum("bc,thdc->bthd", h, qw) + qb  # (B,3,H,Dh)
+                qh, kh, vh = qkv[:, 0], qkv[:, 1], qkv[:, 2]
                 ck_l = lax.dynamic_update_slice(
                     ck_l, kh[:, :, None], (0, 0, pos, 0))
                 cv_l = lax.dynamic_update_slice(
@@ -287,7 +326,7 @@ class CachedDecoder:
                 scores = jnp.where(mask[None, None], scores, -1e30)
                 p = jax.nn.softmax(scores, axis=-1)
                 attn = jnp.einsum("bhw,bhwd->bhd", p, cv_l)
-                attn = attn.reshape(B, C) @ pw.T + pb
+                attn = jnp.einsum("bhd,chd->bc", attn, pw) + pb
                 x = x + attn
                 h = layer_norm(x, g2, b2)
                 h = h @ f1w.T + f1b
@@ -296,10 +335,7 @@ class CachedDecoder:
                 x = x + (h @ f2w.T + f2b)
                 return x, (ck_l, cv_l)
 
-            per_layer = (s["qkv_stack_weight"], s["qkv_stack_bias"],
-                         s["proj_stack_weight"], s["proj_stack_bias"],
-                         s["ffn1_stack_weight"], s["ffn1_stack_bias"],
-                         s["ffn2_stack_weight"], s["ffn2_stack_bias"],
+            per_layer = (qkvw, qkvb, pwh, pb, f1w, f1b, f2w, f2b,
                          s["ln1_stack_gamma"], s["ln1_stack_beta"],
                          s["ln2_stack_gamma"], s["ln2_stack_beta"],
                          ck, cv)
@@ -311,11 +347,14 @@ class CachedDecoder:
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
 
     def decode(self, ids, max_new_tokens=16, temperature=None,
-               rng=None):
+               rng=None, return_logits=False):
         """ids: (B, T0) NDArray seed; returns (B, T0+N) NDArray like
         generate(), at O(W) per new token.  The cache window is fixed:
         T0 + max_new_tokens must fit max_length (generate()'s sliding
-        window has no cache to shift, so it has no such bound)."""
+        window has no cache to shift, so it has no such bound).
+
+        With ``return_logits=True`` also returns the (N, B, vocab)
+        pre-sampling logits stack (scoring / equivalence checks)."""
         import numpy as np
 
         import jax.numpy as jnp
@@ -335,21 +374,33 @@ class CachedDecoder:
                 f"decode: {T0} seed + {max_new_tokens} new tokens "
                 f"exceed the cache window max_length={W}; use "
                 "generate() for sliding-window decoding")
-        ck = jnp.zeros((L, B, H, W, Dh), self._tok.dtype)
-        cv = jnp.zeros((L, B, H, W, Dh), self._tok.dtype)
+        cache_spec = (None, None, self._tp_axis, None, None)
+        ck = self._shard(jnp.zeros((L, B, H, W, Dh), self._tok.dtype),
+                         cache_spec)
+        cv = self._shard(jnp.zeros((L, B, H, W, Dh), self._tok.dtype),
+                         cache_spec)
         # prefill: feed seed tokens one by one through the SAME step fn
         # (one compiled program total; prefill cost O(T0·W))
         logits = None
         for t in range(T0):
             ck, cv, logits = self._step_fn(
                 ck, cv, jnp.asarray(t), jnp.asarray(out[:, t]))
+        lg = []
         for n in range(max_new_tokens):
-            nxt = _sample(np.asarray(logits), temperature, rng)
+            cur = np.asarray(logits)
+            lg.append(cur)
+            nxt = _sample(cur, temperature, rng)
             out = np.concatenate([out, nxt[:, None]], axis=1)
             if n < max_new_tokens - 1:   # last token needs no step
                 ck, cv, logits = self._step_fn(
                     ck, cv, jnp.asarray(T0 + n), jnp.asarray(nxt))
-        return nd.array(out.astype(np.float32))
+        toks = nd.array(out.astype(np.float32))
+        if return_logits:
+            vocab = self._tok.shape[0]
+            stacked = np.stack(lg) if lg else \
+                np.zeros((0, B, vocab), np.float32)
+            return toks, stacked
+        return toks
 
 
 # -- pipeline-parallel parts ---------------------------------------------------

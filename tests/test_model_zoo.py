@@ -425,6 +425,46 @@ def test_gpt_cached_decoder_matches_recompute():
         np.testing.assert_array_equal(ref, dec, err_msg=f"scan={scan}")
 
 
+def test_gpt_cached_decoder_tensor_parallel():
+    """tp-sharded serving: CachedDecoder(mesh=) shards heads, the KV
+    cache, and the FFN hidden dim over the tp axis (Megatron rules,
+    GSPMD collectives) and produces the same tokens as the
+    single-device cached decoder."""
+    import jax
+    from jax.sharding import Mesh
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    net = gpt.gpt_tiny(scan_layers=True)
+    net.initialize(init=mx.init.Xavier())
+    ids = nd.array(np.random.RandomState(1)
+                   .randint(0, 128, (2, 6)).astype(np.float32))
+    net(ids)
+    ref_t, ref_lg = gpt.CachedDecoder(net).decode(
+        ids, max_new_tokens=5, return_logits=True)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    tp_t, tp_lg = gpt.CachedDecoder(net, mesh=mesh).decode(
+        ids, max_new_tokens=5, return_logits=True)
+    _assert_decode_equiv(ref_t.asnumpy(), ref_lg, tp_t.asnumpy(), tp_lg,
+                         T0=ids.shape[1])
+
+
+def _assert_decode_equiv(ref_t, ref_lg, tp_t, tp_lg, T0):
+    """Greedy tokens should match; if argmax flips, it is legitimate
+    ONLY inside float32 rounding noise — the sharded partial-sum
+    all-reduce associates reductions differently, so the contract is
+    logits-to-rounding, tokens-in-practice."""
+    np.testing.assert_allclose(tp_lg[0], ref_lg[0], rtol=2e-4, atol=1e-5)
+    if np.array_equal(ref_t, tp_t):
+        return
+    j = int(np.argwhere((ref_t != tp_t).any(axis=0))[0, 0]) - T0
+    np.testing.assert_allclose(
+        tp_lg[j], ref_lg[j], rtol=2e-4, atol=1e-5,
+        err_msg=f"tokens diverged at step {j} with logits beyond "
+                "rounding tolerance")
+
+
 def test_gpt_flash_attention_trains():
     """The causal LM with attention_impl='flash' (interpret mode on
     CPU): the Pallas causal kernel inside the full training step."""
