@@ -189,10 +189,11 @@ class CachedDecoder:
     per-layer all-reduces — multi-chip decode with no code change.
     """
 
-    def __init__(self, model, mesh=None, tp_axis="tp"):
+    def __init__(self, model, mesh=None, tp_axis="tp", dtype=None):
         self._W = model._max_length
         self._mesh = mesh
         self._tp_axis = tp_axis
+        self._dtype = dtype
         params = dict(model.collect_params())
 
         def get1(suffix):
@@ -250,6 +251,18 @@ class CachedDecoder:
         self._lnf = (lnf_g, lnf_b)
         self._tok = get1("tok_embed_weight")
         self._pos = get1("pos_embed_weight")
+        if dtype is not None:
+            # Serving precision: the BIG tensors (weight stacks, embed
+            # tables, and — via self._tok.dtype — the KV cache) go
+            # bf16 in HBM; LN/bias params and all accumulations stay
+            # f32 (jnp promotion), so this halves the HBM traffic the
+            # bandwidth-bound decode step is limited by without
+            # touching the numerics-sensitive reductions.
+            for nm in ("qkv_stack_weight", "proj_stack_weight",
+                       "ffn1_stack_weight", "ffn2_stack_weight"):
+                self._stacks[nm] = self._stacks[nm].astype(dtype)
+            self._tok = self._tok.astype(dtype)
+            self._pos = self._pos.astype(dtype)
         self._H = num_heads
         self._act = act
         self._step_fn = None
@@ -264,6 +277,18 @@ class CachedDecoder:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         return jax.device_put(arr, NamedSharding(self._mesh, P(*spec)))
+
+    def _init_cache(self, B):
+        """Fresh zeroed (ck, cv) for batch B, with the serving dtype
+        and (when a tp mesh is set) the head-sharded layout."""
+        import jax.numpy as jnp
+
+        L = self._stacks["qkv_stack_weight"].shape[0]
+        Dh = self._tok.shape[1] // self._H
+        spec = (None, None, self._tp_axis, None, None)
+        shape = (L, B, self._H, self._W, Dh)
+        return (self._shard(jnp.zeros(shape, self._tok.dtype), spec),
+                self._shard(jnp.zeros(shape, self._tok.dtype), spec))
 
     def _build(self):
         import jax
@@ -305,28 +330,38 @@ class CachedDecoder:
         f2w = self._shard(s["ffn2_stack_weight"], (None, None, tp))
         pb, f2b = s["proj_stack_bias"], s["ffn2_stack_bias"]
 
-        def step(ck, cv, pos, tok):
-            """ck/cv: (L, B, H, W, Dh); pos: scalar; tok: (B,) int32.
-            Returns (new_ck, new_cv, logits (B, vocab))."""
-            x = jnp.take(tok_e, tok, axis=0) + pos_e[pos]     # (B, C)
+        def step(ck, cv, pos, toks):
+            """Block step: ck/cv (L, B, H, W, Dh); pos scalar (write
+            offset); toks (B, S) int32 — S tokens processed in one
+            causal pass (S=1 is the classic per-token step; S=T0 is
+            chunked prefill; S=k verifies a speculative draft block).
+            Returns (new_ck, new_cv, logits (B, S, vocab))."""
+            S = toks.shape[1]
+            # residual stream in f32 regardless of the serving dtype
+            x = (jnp.take(tok_e, toks, axis=0) +
+                 lax.dynamic_slice(pos_e, (pos, 0), (S, C))[None]
+                 ).astype(jnp.float32)                        # (B, S, C)
 
             def layer(x, per):
                 (qw, qb, pw, pb, f1w, f1b, f2w, f2b, g1, b1, g2, b2,
                  ck_l, cv_l) = per
                 h = layer_norm(x, g1, b1)
-                qkv = jnp.einsum("bc,thdc->bthd", h, qw) + qb  # (B,3,H,Dh)
-                qh, kh, vh = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+                qkv = jnp.einsum("bsc,thdc->bsthd", h, qw) + qb
+                qh = qkv[:, :, 0].swapaxes(1, 2)     # (B, H, S, Dh)
+                kh = qkv[:, :, 1].swapaxes(1, 2)
+                vh = qkv[:, :, 2].swapaxes(1, 2)
                 ck_l = lax.dynamic_update_slice(
-                    ck_l, kh[:, :, None], (0, 0, pos, 0))
+                    ck_l, kh.astype(ck_l.dtype), (0, 0, pos, 0))
                 cv_l = lax.dynamic_update_slice(
-                    cv_l, vh[:, :, None], (0, 0, pos, 0))
-                scores = jnp.einsum("bhd,bhwd->bhw", qh, ck_l) \
+                    cv_l, vh.astype(cv_l.dtype), (0, 0, pos, 0))
+                scores = jnp.einsum("bhsd,bhwd->bhsw", qh, ck_l) \
                     * (Dh ** -0.5)
-                mask = jnp.arange(W) <= pos
+                mask = jnp.arange(W)[None, :] <= \
+                    pos + jnp.arange(S)[:, None]              # (S, W)
                 scores = jnp.where(mask[None, None], scores, -1e30)
                 p = jax.nn.softmax(scores, axis=-1)
-                attn = jnp.einsum("bhw,bhwd->bhd", p, cv_l)
-                attn = jnp.einsum("bhd,chd->bc", attn, pw) + pb
+                attn = jnp.einsum("bhsw,bhwd->bhsd", p, cv_l)
+                attn = jnp.einsum("bhsd,chd->bsc", attn, pw) + pb
                 x = x + attn
                 h = layer_norm(x, g2, b2)
                 h = h @ f1w.T + f1b
@@ -341,7 +376,7 @@ class CachedDecoder:
                          ck, cv)
             x, (ck2, cv2) = lax.scan(layer, x, per_layer)
             h = layer_norm(x, lnf_g, lnf_b)
-            logits = h @ tok_e.T
+            logits = h @ tok_e.T   # bf16 table promotes to f32 in-op
             return ck2, cv2, logits
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
@@ -365,26 +400,28 @@ class CachedDecoder:
             self._build()
         out = ids.asnumpy().astype(np.int32)
         B, T0 = out.shape
-        L = self._stacks["qkv_stack_weight"].shape[0]
-        H, W = self._H, self._W
-        C = self._tok.shape[1]
-        Dh = C // H
-        if T0 + max_new_tokens > W:
+        if T0 + max_new_tokens > self._W:
             raise ValueError(
                 f"decode: {T0} seed + {max_new_tokens} new tokens "
-                f"exceed the cache window max_length={W}; use "
+                f"exceed the cache window max_length={self._W}; use "
                 "generate() for sliding-window decoding")
-        cache_spec = (None, None, self._tp_axis, None, None)
-        ck = self._shard(jnp.zeros((L, B, H, W, Dh), self._tok.dtype),
-                         cache_spec)
-        cv = self._shard(jnp.zeros((L, B, H, W, Dh), self._tok.dtype),
-                         cache_spec)
-        # prefill: feed seed tokens one by one through the SAME step fn
-        # (one compiled program total; prefill cost O(T0·W))
-        logits = None
-        for t in range(T0):
-            ck, cv, logits = self._step_fn(
-                ck, cv, jnp.asarray(t), jnp.asarray(out[:, t]))
+        ck, cv = self._init_cache(B)
+        # Chunked prefill: the whole seed in ONE block-step call.  The
+        # seed is right-padded to a power-of-two bucket so a serving
+        # loop with varied prompt lengths compiles log2(W) prefill
+        # programs, not one per distinct T0.  Pad garbage written at
+        # cache positions >= T0 is harmless: position q only becomes
+        # attendable at the step whose pos == q, and that same step
+        # overwrites q before attending.
+        T0p = 8
+        while T0p < T0:
+            T0p *= 2
+        T0p = min(T0p, self._W)
+        padded = np.zeros((B, T0p), np.int32)
+        padded[:, :T0] = out
+        ck, cv, logits = self._step_fn(
+            ck, cv, jnp.asarray(0), jnp.asarray(padded))
+        logits = logits[:, T0 - 1]
         lg = []
         for n in range(max_new_tokens):
             cur = np.asarray(logits)
@@ -393,7 +430,8 @@ class CachedDecoder:
             out = np.concatenate([out, nxt[:, None]], axis=1)
             if n < max_new_tokens - 1:   # last token needs no step
                 ck, cv, logits = self._step_fn(
-                    ck, cv, jnp.asarray(T0 + n), jnp.asarray(nxt))
+                    ck, cv, jnp.asarray(T0 + n), jnp.asarray(nxt[:, None]))
+                logits = logits[:, -1]
         toks = nd.array(out.astype(np.float32))
         if return_logits:
             vocab = self._tok.shape[0]
@@ -401,6 +439,125 @@ class CachedDecoder:
                 np.zeros((0, B, vocab), np.float32)
             return toks, stacked
         return toks
+
+
+def speculative_decode(target, draft, ids, max_new_tokens=16, k=4,
+                       return_stats=False):
+    """Greedy speculative decoding (LOSSLESS: emits exactly the tokens
+    ``CachedDecoder(target).decode`` would emit greedily).
+
+    The cheap ``draft`` model proposes ``k`` tokens with k O(1)-context
+    steps; the ``target`` verifies the whole block in ONE block-step
+    (the same MXU-friendly shape as chunked prefill), accepting the
+    longest prefix where the target's own greedy choice agrees, plus
+    the target's replacement token at the first disagreement.  Batched:
+    rows advance in lockstep at the minimum per-row acceptance (greedy
+    determinism makes re-proposal of the tail exact, so uniform
+    progress stays lossless).
+
+    target/draft: GPTModel or CachedDecoder (tp/bf16 decoders work).
+    Returns (B, T0+N) tokens; with ``return_stats=True`` also a dict
+    with rounds / accepted-token counts.
+
+    Caveat: "exactly" is up to float32 rounding ties — the S=k+1
+    verify step may reduce in a different order than decode()'s S=1
+    step, so an argmax sitting inside rounding noise can flip (the
+    same class of tie the tp all-reduce path documents).
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ... import ndarray as nd
+
+    tgt = target if isinstance(target, CachedDecoder) \
+        else CachedDecoder(target)
+    drf = draft if isinstance(draft, CachedDecoder) \
+        else CachedDecoder(draft)
+    for dec in (tgt, drf):
+        if dec._step_fn is None:
+            dec._build()
+
+    out = ids.asnumpy().astype(np.int32)
+    B, T0 = out.shape
+    total = T0 + max_new_tokens
+    if total + k > min(tgt._W, drf._W):
+        raise ValueError(
+            f"speculative_decode: {total} tokens + {k} draft overshoot "
+            f"exceed cache window (target {tgt._W}, draft {drf._W})")
+
+    t_ck, t_cv = tgt._init_cache(B)
+    d_ck, d_cv = drf._init_cache(B)
+    # prefill BOTH through the seed minus its last token: the invariant
+    # is "cache holds positions < P-1; the last committed token is the
+    # next thing fed", so the seed's last token heads the first block.
+    # Right-padded to a power-of-two bucket (same compile-count and
+    # pad-garbage-overwrite argument as decode()'s chunked prefill).
+    if T0 > 1:
+        Tp = 8
+        while Tp < T0 - 1:
+            Tp *= 2
+        Tp = min(Tp, min(tgt._W, drf._W))
+        padded = np.zeros((B, Tp), np.int32)
+        padded[:, :T0 - 1] = out[:, :-1]
+        t_ck, t_cv, _ = tgt._step_fn(
+            t_ck, t_cv, jnp.asarray(0), jnp.asarray(padded))
+        d_ck, d_cv, _ = drf._step_fn(
+            d_ck, d_cv, jnp.asarray(0), jnp.asarray(padded))
+
+    P = T0
+    dp = T0 - 1  # next draft-cache position to write
+    rounds = accepted_total = 0
+    while P < total:
+        # 0. draft cache catch-up: after a full-accept round the bonus
+        # token advanced P past what the proposal loop wrote (it writes
+        # through P+k-2, the bonus needs P+k-1) — feed the missing
+        # committed token(s) so the draft never attends a stale slot
+        while dp < P - 1:
+            d_ck, d_cv, _ = drf._step_fn(
+                d_ck, d_cv, jnp.asarray(dp),
+                jnp.asarray(out[:, dp][:, None]))
+            dp += 1
+        # 1. draft proposes k tokens, one cheap step each
+        props = np.zeros((B, k), np.int32)
+        last = out[:, P - 1]
+        for j in range(k):
+            d_ck, d_cv, d_lg = drf._step_fn(
+                d_ck, d_cv, jnp.asarray(P - 1 + j),
+                jnp.asarray(last[:, None]))
+            last = np.argmax(np.asarray(d_lg[:, -1]), axis=-1) \
+                .astype(np.int32)
+            props[:, j] = last
+        dp = P - 1 + k
+        # 2. target verifies in ONE (k+1)-block step: inputs are the
+        # last committed token + all k proposals at positions P-1..;
+        # choice[:, j] is the target's greedy pick for position P+j —
+        # including the FREE bonus token choice[:, k] on full accept
+        block = np.concatenate([out[:, P - 1:P], props], axis=1)
+        t_ck, t_cv, t_lg = tgt._step_fn(
+            t_ck, t_cv, jnp.asarray(P - 1), jnp.asarray(block))
+        choice = np.argmax(np.asarray(t_lg), axis=-1) \
+            .astype(np.int32)                            # (B, k+1)
+        # 3. longest agreeing prefix, uniform across the batch
+        agree = (props == choice[:, :k])
+        full = agree.all(axis=1)
+        first_bad = np.where(full, k, np.argmin(agree, axis=1))
+        m = int(first_bad.min())
+        # commit m accepted proposals + the target's own next token
+        # (replacement at the first disagreement, bonus on full accept)
+        commit = np.concatenate(
+            [props[:, :m], choice[:, m:m + 1]], axis=1)
+        commit = commit[:, :total - P]
+        out = np.concatenate([out, commit], axis=1)
+        P += commit.shape[1]
+        rounds += 1
+        accepted_total += min(m, commit.shape[1])
+    toks = nd.array(out.astype(np.float32))
+    if return_stats:
+        return toks, {"rounds": rounds, "proposed_per_round": k,
+                      "accepted_draft_tokens": accepted_total,
+                      "new_tokens": max_new_tokens}
+    return toks
 
 
 # -- pipeline-parallel parts ---------------------------------------------------

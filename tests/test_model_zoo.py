@@ -450,6 +450,83 @@ def test_gpt_cached_decoder_tensor_parallel():
                          T0=ids.shape[1])
 
 
+def test_gpt_cached_decoder_bf16_serving():
+    """dtype='bfloat16' puts the big tensors (weight stacks, embed
+    tables, KV cache) in bf16 HBM while accumulating f32 — logits stay
+    within bf16 tolerance of the f32 decoder, also combined with tp."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    net = gpt.gpt_tiny(scan_layers=True)
+    net.initialize(init=mx.init.Xavier())
+    ids = nd.array(np.random.RandomState(2)
+                   .randint(0, 128, (2, 6)).astype(np.float32))
+    net(ids)
+    _, ref_lg = gpt.CachedDecoder(net).decode(
+        ids, max_new_tokens=3, return_logits=True)
+    dec = gpt.CachedDecoder(net, dtype="bfloat16")
+    toks, lg = dec.decode(ids, max_new_tokens=3, return_logits=True)
+    assert toks.shape == (2, 9)
+    scale = np.abs(ref_lg[0]).max()
+    np.testing.assert_allclose(lg[0], ref_lg[0], atol=0.05 * scale)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    _, lg_tp = gpt.CachedDecoder(net, mesh=mesh, dtype="bfloat16").decode(
+        ids, max_new_tokens=3, return_logits=True)
+    np.testing.assert_allclose(lg_tp[0], ref_lg[0], atol=0.05 * scale)
+    # the cache really is bf16 (the HBM claim)
+    dec._build()
+    assert dec._tok.dtype == jnp.bfloat16
+
+
+def test_gpt_speculative_decode_lossless():
+    """Speculative decoding emits EXACTLY the target's greedy tokens —
+    with a self-draft (all-accept fast path), an independent weaker
+    draft (mixed accept/reject), and batch > 1 (uniform-min progress)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    tgt = gpt.gpt_tiny(scan_layers=True)
+    tgt.initialize(init=mx.init.Xavier())
+    ids = nd.array(np.random.RandomState(5)
+                   .randint(0, 128, (3, 7)).astype(np.float32))
+    tgt(ids)
+    ref_nd, ref_lg = gpt.CachedDecoder(tgt).decode(
+        ids, max_new_tokens=9, return_logits=True)
+    ref = ref_nd.asnumpy()
+
+    def assert_lossless(spec_np):
+        """Token-exact, except a divergence whose reference top-2
+        margin is inside rounding noise (S=1 vs S=k+1 reduction-order
+        ties — see the speculative_decode docstring)."""
+        if np.array_equal(spec_np, ref):
+            return
+        j = int(np.argwhere((spec_np != ref).any(axis=0))[0, 0]) \
+            - ids.shape[1]
+        top2 = np.sort(ref_lg[j], axis=-1)[:, -2:]
+        margin = float((top2[:, 1] - top2[:, 0]).min())
+        assert margin < 1e-3 * np.abs(ref_lg[j]).max(), \
+            f"diverged at step {j} with a decisive margin {margin}"
+
+    # self-draft: every (untrimmed) proposal must be accepted
+    spec, st = gpt.speculative_decode(tgt, tgt, ids, max_new_tokens=9,
+                                      k=3, return_stats=True)
+    assert_lossless(spec.asnumpy())
+    assert st["accepted_draft_tokens"] >= 6  # all-accept up to trim
+
+    # independent draft: still lossless, some rejections expected
+    drf = gpt.gpt_tiny(scan_layers=True)
+    drf.initialize(init=mx.init.Xavier())
+    drf(ids)
+    spec2, st2 = gpt.speculative_decode(tgt, drf, ids, max_new_tokens=9,
+                                        k=3, return_stats=True)
+    assert_lossless(spec2.asnumpy())
+    assert st2["rounds"] >= st["rounds"]
+
+
 def _assert_decode_equiv(ref_t, ref_lg, tp_t, tp_lg, T0):
     """Greedy tokens should match; if argmax flips, it is legitimate
     ONLY inside float32 rounding noise — the sharded partial-sum

@@ -33,8 +33,10 @@ def main(batch=8, seed_len=16, new_tokens=48, units=256, layers=4,
     net(ids)
 
     dec = gpt.CachedDecoder(net)
-    # warm both paths (compiles)
+    dec_bf16 = gpt.CachedDecoder(net, dtype="bfloat16")
+    # warm all paths (compiles)
     dec.decode(ids, max_new_tokens=2)
+    dec_bf16.decode(ids, max_new_tokens=2)
     gpt.generate(net, ids, max_new_tokens=2)
 
     t0 = time.perf_counter()
@@ -43,11 +45,17 @@ def main(batch=8, seed_len=16, new_tokens=48, units=256, layers=4,
     dt_cache = time.perf_counter() - t0
 
     t0 = time.perf_counter()
+    out = dec_bf16.decode(ids, max_new_tokens=new_tokens)
+    np.asarray(out._data)
+    dt_bf16 = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
     out = gpt.generate(net, ids, max_new_tokens=new_tokens)
     np.asarray(out._data)
     dt_full = time.perf_counter() - t0
 
     tps_cache = batch * new_tokens / dt_cache
+    tps_bf16 = batch * new_tokens / dt_bf16
     tps_full = batch * new_tokens / dt_full
     print(json.dumps({
         "bench": "gpt_decode",
@@ -55,8 +63,10 @@ def main(batch=8, seed_len=16, new_tokens=48, units=256, layers=4,
                    "window": window, "vocab": vocab,
                    "new_tokens": new_tokens},
         "kv_cache_tokens_per_sec": round(tps_cache, 1),
+        "kv_cache_bf16_tokens_per_sec": round(tps_bf16, 1),
         "recompute_tokens_per_sec": round(tps_full, 1),
         "speedup": round(tps_cache / tps_full, 2),
+        "bf16_speedup_over_f32_cache": round(tps_bf16 / tps_cache, 2),
     }))
 
 
