@@ -149,6 +149,24 @@ def adadelta_update_pure(weight, grad, acc_g, acc_delta, rho=0.9,
     return weight - delta, acc_g, acc_delta
 
 
+def lars_update_pure(weight, grad, mom, lr, eta=0.001, momentum=0.9,
+                     wd=0.0, epsilon=1e-9, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """LARS layer-wise adaptive SGD (reference: lars_update /
+    preloaded_multi_sgd kernels ≥1.6 and the LBSGD python optimizer):
+    the layer's lr is scaled by eta·||w|| / (||g|| + wd·||w|| + eps),
+    then an SGD-momentum step runs with it."""
+    grad = _rescale(grad, rescale_grad, clip_gradient)
+    w_norm = jnp.linalg.norm(weight)
+    g_norm = jnp.linalg.norm(grad)
+    ratio = jnp.where((w_norm > 0.0) & (g_norm > 0.0),
+                      eta * w_norm / (g_norm + wd * w_norm + epsilon),
+                      1.0)
+    lr = lr * ratio
+    mom = momentum * mom - lr * (grad + wd * weight)
+    return weight + mom, mom
+
+
 def lamb_update_phase1_pure(weight, grad, mean, var, t=1, beta1=0.9,
                             beta2=0.999, epsilon=1e-6, wd=0.0,
                             bias_correction=True, rescale_grad=1.0,
@@ -193,6 +211,7 @@ mp_sgd_mom_update_pure = _mp(sgd_mom_update_pure)
 mp_nag_mom_update_pure = _mp(nag_mom_update_pure)
 mp_adam_update_pure = _mp(adam_update_pure)
 mp_lamb_update_phase1_pure = _mp(lamb_update_phase1_pure)
+mp_lars_update_pure = _mp(lars_update_pure)
 
 
 # -- NDArray wrappers (reference in-place mutation contract) -------------------
@@ -234,6 +253,8 @@ for _name, _fn in [
     ("signum_update", signum_update_pure),
     ("adagrad_update", adagrad_update_pure),
     ("adadelta_update", adadelta_update_pure),
+    ("lars_update", lars_update_pure),
+    ("mp_lars_update", mp_lars_update_pure),
     ("lamb_update_phase1", lamb_update_phase1_pure),
     ("lamb_update_phase2", lamb_update_phase2_pure),
     ("mp_sgd_update", mp_sgd_update_pure),
@@ -258,4 +279,5 @@ PURE_UPDATES = {
     "signum_update": signum_update_pure,
     "adagrad_update": adagrad_update_pure,
     "adadelta_update": adadelta_update_pure,
+    "lars_update": lars_update_pure,
 }

@@ -678,6 +678,41 @@ class LAMB(Optimizer):
 
 
 @register
+class LARS(Optimizer):
+    """LARS layer-wise adaptive SGD for large-batch training
+    (reference: LBSGD optimizer + lars_update kernels ≥1.6).
+
+    1-D parameters (biases, BN gamma/beta) take the plain SGD-momentum
+    step — the reference's skip list — since norm-ratio adaptation on
+    them destabilizes training."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        return _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = self._common()
+        if len(weight.shape) <= 1:
+            self._apply(_op.sgd_mom_update_pure, weight, [state], grad,
+                        lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            self._apply(_op.lars_update_pure, weight, [state], grad,
+                        lr=lr, wd=wd, momentum=self.momentum,
+                        eta=self.eta, epsilon=self.epsilon, **kw)
+
+
+@register
 class AdamW(Optimizer):
     """Adam with decoupled weight decay (reference: contrib.AdamW)."""
 

@@ -450,6 +450,8 @@ SPECS = {
     "signsgd_update": _opt(0),
     "signum_update": _opt(1, momentum=0.9),
     "adagrad_update": _opt(1),
+    "lars_update": _opt(1, momentum=0.9, eta=0.01),
+    "mp_lars_update": _opt(1, mp=True, momentum=0.9, eta=0.01),
     "adadelta_update": Spec([N(5), N(5), np.zeros(5, np.float32),
                              np.zeros(5, np.float32)], {"rho": 0.9}),
     "lamb_update_phase1": Spec([N(5), N(5), np.zeros(5, np.float32),

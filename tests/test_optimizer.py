@@ -115,6 +115,35 @@ def test_lamb_runs():
     assert not np.allclose(before, w.asnumpy())
 
 
+def test_lars_oracle_and_bias_path():
+    """lars_update against a numpy oracle (trust ratio × SGD-mom) on a
+    2-D weight; 1-D params take the plain SGD-momentum step (the
+    reference LBSGD skip list)."""
+    rs = np.random.RandomState(0)
+    w0 = rs.uniform(-1, 1, (6, 3)).astype(np.float32)
+    g0 = rs.uniform(-1, 1, (6, 3)).astype(np.float32)
+    lr, eta, mom_c, wd = 0.1, 0.01, 0.9, 0.001
+    w, g = _nd(w0.copy()), _nd(g0)
+    o = opt.LARS(learning_rate=lr, eta=eta, momentum=mom_c, wd=wd)
+    st = o.create_state(0, w)
+    o.update(0, w, g, st)
+    ratio = eta * np.linalg.norm(w0) / (
+        np.linalg.norm(g0) + wd * np.linalg.norm(w0) + 1e-9)
+    mom = -lr * ratio * (g0 + wd * w0)
+    np.testing.assert_allclose(w.asnumpy(), w0 + mom, rtol=1e-5,
+                               atol=1e-6)
+    # second step exercises momentum accumulation
+    o.update(0, w, g, st)
+    assert np.all(np.isfinite(w.asnumpy()))
+    # 1-D bias: no trust ratio — exact SGD-momentum result
+    b0 = rs.uniform(-1, 1, (4,)).astype(np.float32)
+    b, gb = _nd(b0.copy()), _nd(np.full((4,), 0.5, np.float32))
+    stb = o.create_state(1, b)
+    o.update(1, b, gb, stb)
+    np.testing.assert_allclose(
+        b.asnumpy(), b0 - lr * (0.5 + wd * b0), rtol=1e-6)
+
+
 def test_multi_precision_master_weights():
     w = _nd(np.ones((5,))).astype(np.float16)
     g = _nd(np.full((5,), 0.1)).astype(np.float16)
