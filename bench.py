@@ -92,7 +92,12 @@ def _probe_backend():
 
 def _attempts(tpu_ok):
     steps = int(os.environ.get("BENCH_STEPS", 20))
-    budget = int(os.environ.get("BENCH_BUDGET", 560))
+    # 1000s not 560s: a COLD remote-AOT compile of the b256 step through
+    # the tunnel was measured >560s (round 5) — one generously-budgeted
+    # attempt beats two that both die mid-compile (each kill also risks
+    # wedging the tunnel with a stale claim).  A warm .jax_cache makes
+    # the attempt finish in ~2 min regardless of this budget.
+    budget = int(os.environ.get("BENCH_BUDGET", 1000))
     layout = os.environ.get("BENCH_LAYOUT", "NCHW")
     tpu_attempts = [] if not tpu_ok else [
         (None, {"model": "resnet50",
@@ -100,9 +105,12 @@ def _attempts(tpu_ok):
                 "image": int(os.environ.get("BENCH_IMAGE", 224)),
                 "steps": steps, "backend": "tpu", "layout": layout},
          budget),
+        # reached only if the b256 attempt failed FAST (OOM / compile
+        # error): a timeout skips straight to CPU (same cold-compile
+        # wall, and another kill risks wedging the tunnel)
         (None, {"model": "resnet50", "batch": 64, "image": 224,
                 "steps": 10, "backend": "tpu", "layout": layout},
-         min(300, budget)),
+         min(600, budget)),
     ]
     return tpu_attempts + [
         ({"JAX_PLATFORMS": "cpu"},
@@ -113,7 +121,8 @@ def _attempts(tpu_ok):
 
 def _bert_attempts(tpu_ok):
     steps = int(os.environ.get("BENCH_BERT_STEPS", 12))
-    budget = int(os.environ.get("BENCH_BERT_BUDGET", 420))
+    # 900s default for the same cold-compile reason as _attempts
+    budget = int(os.environ.get("BENCH_BERT_BUDGET", 900))
     if not tpu_ok:
         return [({"JAX_PLATFORMS": "cpu"},
                  {"model": "bert", "batch": 2, "seq": 128, "steps": 2,
@@ -124,16 +133,19 @@ def _bert_attempts(tpu_ok):
                 "seq": int(os.environ.get("BENCH_BERT_SEQ", 512)),
                 "steps": steps, "backend": "tpu", "attn": "flash"},
          budget),
-        (None, {"model": "bert", "batch": 8, "seq": 512, "steps": 6,
-                "backend": "tpu", "attn": "flash"}, min(300, budget)),
         # dense-attention fallback: a Pallas/Mosaic compile failure must
         # not cost the whole metric
         (None, {"model": "bert", "batch": 16, "seq": 512, "steps": 6,
                 "backend": "tpu", "attn": "dense"}, min(420, budget)),
+        # a flash TIMEOUT skips the dense TPU attempt (same cold-compile
+        # wall) — this CPU entry keeps the metric non-null even then
+        ({"JAX_PLATFORMS": "cpu"},
+         {"model": "bert", "batch": 2, "seq": 128, "steps": 2,
+          "backend": "cpu", "attn": "dense"}, 240),
     ]
 
 
-def _run_worker(env_over, cfg, budget, errors):
+def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
         # CPU fallback: strip anything that could claim the tunnel
@@ -148,6 +160,8 @@ def _run_worker(env_over, cfg, budget, errors):
     except subprocess.TimeoutExpired:
         errors.append(f"{cfg['model']}/{cfg['backend']} "
                       f"b{cfg['batch']}: timeout {budget}s")
+        if timed_out is not None:
+            timed_out.append(cfg)
         return None
     for ln in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -177,15 +191,22 @@ def orchestrate():
         if not tpu_ok:
             errors.append(f"tpu skipped ({probe_note})")
     headline = None
+    timed_out = []
     for env_over, cfg, budget in _attempts(tpu_ok):
-        headline = _run_worker(env_over, cfg, budget, errors)
+        if timed_out and cfg.get("backend") == "tpu":
+            continue  # cold-compile wall: don't re-kill on the tunnel
+        headline = _run_worker(env_over, cfg, budget, errors, timed_out)
         if headline is not None:
             break
     bert = None
     bert_errors = []
     if headline is not None and not os.environ.get("BENCH_SKIP_BERT"):
+        bert_timed_out = []
         for env_over, cfg, budget in _bert_attempts(tpu_ok):
-            bert = _run_worker(env_over, cfg, budget, bert_errors)
+            if bert_timed_out and cfg.get("backend") == "tpu":
+                continue
+            bert = _run_worker(env_over, cfg, budget, bert_errors,
+                               bert_timed_out)
             if bert is not None:
                 break
     if headline is None:

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Faster R-CNN end-to-end training (reference: example/rcnn/train_end2end.py
+— the two-stage detection tier of the example zoo).
+
+The full reference pipeline, condensed to a CI-runnable synthetic task:
+
+  backbone -> RPN head -> (anchor targets: numpy, like rpn/rpn.py)
+           -> Proposal op (static-shape RPN decode + NMS, autograd-paused)
+           -> proposal_target (numpy, like the reference's CustomOp
+              rcnn/io/rpn.py proposal_target layer)
+           -> ROIAlign -> R-CNN head -> cls + per-class bbox refinement
+
+All on-device shapes are static (padded ROI/label tensors, cls=-1/weight=0
+padding) so every op jit-compiles once — the reference's dynamic-shape
+proposal path resolved by the padded contract Proposal already provides.
+
+Synthetic data matches train_ssd.py: bright axis-aligned rectangles,
+class = orientation (0 wide, 1 tall).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NUM_CLASSES = 2          # foreground classes; rcnn head sees K+1 with bg=0
+STRIDE = 8
+SCALES = (3,)
+RATIOS = (0.5, 1, 2)
+A = len(SCALES) * len(RATIOS)
+POST_NMS = 16            # rois per image out of Proposal
+GT_PAD = 2               # max gt boxes per image (synthetic)
+ROIS_PER_IMG = POST_NMS + GT_PAD   # gt boxes appended like the reference
+
+
+def make_anchors(stride, scales, ratios):
+    """Anchor grid seed, reference rcnn/rpn formula (proposal.cc
+    GenerateAnchors): base box [0,0,stride-1,stride-1] reshaped by ratio
+    then scaled."""
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w, h = base[2] + 1, base[3] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        ws = int(round(np.sqrt(w * h / r)))
+        hs = int(round(ws * r))
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.asarray(out, np.float32)
+
+
+def grid_anchors(fh, fw):
+    anchors = make_anchors(STRIDE, SCALES, RATIOS)          # (A, 4)
+    sy = np.arange(fh, dtype=np.float32) * STRIDE
+    sx = np.arange(fw, dtype=np.float32) * STRIDE
+    shift = np.stack(np.meshgrid(sx, sy, indexing="xy"), 0)  # (2,fh,fw) x,y
+    shifts = np.stack([shift[0], shift[1], shift[0], shift[1]],
+                      -1).reshape(-1, 4)                    # (fh*fw, 4)
+    return (anchors[None] + shifts[:, None]).reshape(-1, 4)  # (fh*fw*A, 4)
+
+
+def iou_matrix(a, b):
+    """(N,4) x (M,4) corner-format IoU in pixel coords."""
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(br - tl + 1, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    ar = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    br_ = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / np.maximum(ar[:, None] + br_[None] - inter, 1e-12)
+
+
+def bbox_transform(rois, gt):
+    """Box -> regression target (dx,dy,dw,dh), reference bbox_transform."""
+    w = rois[:, 2] - rois[:, 0] + 1
+    h = rois[:, 3] - rois[:, 1] + 1
+    cx = rois[:, 0] + 0.5 * (w - 1)
+    cy = rois[:, 1] + 0.5 * (h - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - cx) / w, (gcy - cy) / h,
+                     np.log(gw / w), np.log(gh / h)], 1).astype(np.float32)
+
+
+def anchor_targets(anchors, gt_px, gt_cls):
+    """RPN targets for one image (reference AnchorTargetLayer): label
+    1/0/-1(ignore), bbox target + weight per anchor."""
+    N = anchors.shape[0]
+    label = -np.ones(N, np.float32)
+    btarget = np.zeros((N, 4), np.float32)
+    bweight = np.zeros((N, 1), np.float32)
+    valid = gt_cls >= 0
+    if valid.any():
+        gt = gt_px[valid]
+        iou = iou_matrix(anchors, gt)               # (N, G)
+        max_iou = iou.max(1)
+        argmax = iou.argmax(1)
+        label[max_iou < 0.3] = 0
+        label[max_iou >= 0.5] = 1
+        label[iou.argmax(0)] = 1                    # best anchor per gt
+        pos = label == 1
+        btarget[pos] = bbox_transform(anchors[pos], gt[argmax[pos]])
+        bweight[pos] = 1.0
+    else:
+        label[:] = 0
+    return label, btarget, bweight
+
+
+def proposal_targets(rois_px, gt_px, gt_cls):
+    """R-CNN targets for one image's padded roi set (reference
+    proposal_target CustomOp): class label (0=bg), per-class bbox target
+    + weight."""
+    R = rois_px.shape[0]
+    cls = np.zeros(R, np.float32)
+    btarget = np.zeros((R, NUM_CLASSES + 1, 4), np.float32)
+    bweight = np.zeros((R, NUM_CLASSES + 1, 4), np.float32)
+    valid = gt_cls >= 0
+    if valid.any():
+        gt = gt_px[valid]
+        iou = iou_matrix(rois_px, gt)
+        max_iou = iou.max(1)
+        argmax = iou.argmax(1)
+        fg = max_iou >= 0.5
+        cls[fg] = gt_cls[valid][argmax[fg]] + 1     # 0 is background
+        t = bbox_transform(rois_px[fg], gt[argmax[fg]])
+        for i, r in zip(np.where(fg)[0], t):
+            k = int(cls[i])
+            btarget[i, k] = r
+            bweight[i, k] = 1.0
+    return cls, btarget, bweight
+
+
+class RCNN(gluon.HybridBlock):
+    """Tiny Faster R-CNN: conv backbone (stride 8), RPN head, fc R-CNN
+    head (reference: rcnn/symbol/symbol_resnet.py, scaled down)."""
+
+    def __init__(self, channels=32, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.backbone = nn.HybridSequential()
+            for i, c in enumerate((channels // 2, channels, channels)):
+                self.backbone.add(nn.Conv2D(c, 3, strides=2, padding=1))
+                self.backbone.add(nn.Activation("relu"))
+            self.rpn_conv = nn.Conv2D(channels, 3, padding=1,
+                                      activation="relu")
+            self.rpn_cls = nn.Conv2D(2 * A, 1)
+            self.rpn_bbox = nn.Conv2D(4 * A, 1)
+            self.fc = nn.HybridSequential()
+            self.fc.add(nn.Dense(128, activation="relu"))
+            self.rcnn_cls = nn.Dense(NUM_CLASSES + 1)
+            self.rcnn_bbox = nn.Dense(4 * (NUM_CLASSES + 1))
+
+    def features(self, x):
+        feat = self.backbone(x)
+        rpn = self.rpn_conv(feat)
+        return feat, self.rpn_cls(rpn), self.rpn_bbox(rpn)
+
+    def heads(self, pooled):
+        h = self.fc(pooled.reshape((pooled.shape[0], -1)))
+        return self.rcnn_cls(h), self.rcnn_bbox(h)
+
+
+def synthetic_batch(rng, batch_size, size):
+    x = rng.uniform(0, 0.1, (batch_size, 3, size, size)).astype(np.float32)
+    lab = -np.ones((batch_size, GT_PAD, 5), np.float32)
+    for b in range(batch_size):
+        for m in range(rng.randint(1, GT_PAD + 1)):
+            cls = rng.randint(0, NUM_CLASSES)
+            w, h = (0.45, 0.25) if cls == 0 else (0.25, 0.45)
+            cx = rng.uniform(w / 2, 1 - w / 2)
+            cy = rng.uniform(h / 2, 1 - h / 2)
+            box = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+            px = [int(round(v * size)) for v in box]
+            x[b, :, px[1]:px[3], px[0]:px[2]] = rng.uniform(0.8, 1.0)
+            lab[b, m] = [cls] + box
+    return x, lab
+
+
+def smooth_l1_sum(pred, target, weight, norm):
+    """sum(smooth_l1(w*(p-t))) / norm — the reference's rpn/rcnn bbox
+    loss normalization (sum over coords / number of positives)."""
+    l = mx.nd.smooth_l1((pred - target) * weight, scalar=1.0)
+    return l.sum() / norm
+
+
+def train_step(net, trainer, ce, x_np, lab_np, size, anchors):
+    B = x_np.shape[0]
+    x = mx.nd.array(x_np)
+    im_info = mx.nd.array(np.tile([size, size, 1.0], (B, 1)))
+
+    # numpy-side RPN targets (net-independent)
+    rpn_lab, rpn_bt, rpn_bw = zip(*[
+        anchor_targets(anchors, lab_np[b, :, 1:] * size, lab_np[b, :, 0])
+        for b in range(B)])
+    rpn_lab = mx.nd.array(np.stack(rpn_lab))            # (B, N)
+    rpn_bt = mx.nd.array(np.stack(rpn_bt))              # (B, N, 4)
+    rpn_bw = mx.nd.array(np.stack(rpn_bw))              # (B, N, 1)
+
+    with autograd.record():
+        feat, cls_logit, bbox_pred = net.features(x)
+        fh, fw = cls_logit.shape[2], cls_logit.shape[3]
+        # (B, 2A, h, w) -> (B, h*w*A, 2) matching the anchor grid order
+        cls_hw = cls_logit.reshape((B, 2, A, fh, fw)) \
+            .transpose((0, 3, 4, 2, 1)).reshape((B, -1, 2))
+        bbox_hw = bbox_pred.reshape((B, A, 4, fh, fw)) \
+            .transpose((0, 3, 4, 1, 2)).reshape((B, -1, 4))
+        rpn_cls_loss = ce(cls_hw, rpn_lab,
+                          (rpn_lab >= 0).expand_dims(2)).mean()
+        rpn_bbox_loss = smooth_l1_sum(
+            bbox_hw, rpn_bt, rpn_bw, mx.nd.maximum(rpn_bw.sum(), 1.0))
+
+        with autograd.pause():
+            cls_prob = mx.nd.softmax(
+                cls_logit.reshape((B, 2, A, fh, fw)), axis=1) \
+                .reshape((B, 2 * A, fh, fw))
+            rois = mx.nd.contrib.Proposal(
+                cls_prob, bbox_pred, im_info,
+                rpn_pre_nms_top_n=64, rpn_post_nms_top_n=POST_NMS,
+                threshold=0.7, feature_stride=STRIDE, scales=SCALES,
+                ratios=RATIOS, rpn_min_size=4)      # (B*POST_NMS, 5)
+            rois_np = rois.asnumpy().reshape(B, POST_NMS, 5)
+            # append gt boxes so fg rois exist from step 0 (reference
+            # proposal_target does exactly this)
+            gt_rois = np.concatenate(
+                [np.arange(B, dtype=np.float32)[:, None, None].repeat(
+                    GT_PAD, 1),
+                 np.clip(lab_np[:, :, 1:], 0, 1) * size], axis=2)
+            all_rois = np.concatenate([rois_np, gt_rois], axis=1)
+            tgt = [proposal_targets(all_rois[b, :, 1:],
+                                    lab_np[b, :, 1:] * size,
+                                    lab_np[b, :, 0]) for b in range(B)]
+            rcnn_lab = mx.nd.array(np.concatenate([t[0] for t in tgt]))
+            rcnn_bt = mx.nd.array(np.concatenate([t[1] for t in tgt]))
+            rcnn_bw = mx.nd.array(np.concatenate([t[2] for t in tgt]))
+            roi_nd = mx.nd.array(all_rois.reshape(-1, 5))
+
+        pooled = mx.nd.contrib.ROIAlign(
+            feat, roi_nd, pooled_size=(4, 4), spatial_scale=1.0 / STRIDE)
+        rcnn_cls, rcnn_reg = net.heads(pooled)
+        rcnn_reg = rcnn_reg.reshape((-1, NUM_CLASSES + 1, 4))
+        rcnn_cls_loss = ce(rcnn_cls, rcnn_lab).mean()
+        rcnn_bbox_loss = smooth_l1_sum(
+            rcnn_reg, rcnn_bt, rcnn_bw,
+            mx.nd.maximum(rcnn_bw.sum() / 4.0, 1.0))
+        loss = (rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss
+                + rcnn_bbox_loss)
+    loss.backward()
+    trainer.step(B)
+    return [float(v.asnumpy()) for v in
+            (loss, rpn_cls_loss, rcnn_cls_loss)]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=4)
+    parser.add_argument("--size", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--lr", type=float, default=0.02)
+    args = parser.parse_args()
+    if args.size % STRIDE or args.size < 4 * STRIDE:
+        sys.exit(f"--size must be a multiple of {STRIDE} (>= {4 * STRIDE}): "
+                 f"the anchor grid is built at stride {STRIDE}")
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = RCNN()
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    fh = fw = args.size // STRIDE
+    anchors = grid_anchors(fh, fw)
+
+    rng = np.random.RandomState(0)
+    first = last = None
+    for step in range(args.steps):
+        x_np, lab_np = synthetic_batch(rng, args.batch_size, args.size)
+        total, rpn_c, rcnn_c = train_step(
+            net, trainer, ce, x_np, lab_np, args.size, anchors)
+        first = total if first is None else first
+        last = total
+        if step % 5 == 0:
+            print(f"step {step}: loss {total:.4f} "
+                  f"(rpn_cls {rpn_c:.4f} rcnn_cls {rcnn_c:.4f})")
+    print(f"loss first {first:.4f} -> last {last:.4f}")
+    print("rcnn training OK" if last < first else "rcnn loss did not drop")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,9 +15,13 @@ from . import register as _register
 def _expose(namespace=None):
     ns = namespace if namespace is not None else globals()
     for name, opdef in _registry.all_ops().items():
-        if name.startswith("contrib_"):
+        if name.startswith("_contrib_"):
+            public = name[len("_contrib_"):]
+        elif name.startswith("contrib_"):
             public = name[len("contrib_"):]
-            ns.setdefault(public, _register._make_wrapper(opdef))
+        else:
+            continue
+        ns.setdefault(public, _register._make_wrapper(opdef))
 
 
 def div_sqrt_dim(data):

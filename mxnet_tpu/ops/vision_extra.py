@@ -314,7 +314,11 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     import numpy as np
 
     B, A2, H, W = cls_prob.shape
-    A = A2 // 2
+    A = len(scales) * len(ratios)
+    if A2 != 2 * A:
+        raise ValueError(
+            f"Proposal: cls_prob has {A2} channels but scales×ratios "
+            f"defines {A} anchors (need 2·{A} channels: bg+fg per anchor)")
     anchors = jnp.asarray(_make_anchors(feature_stride, scales, ratios))
 
     sy = jnp.arange(H, dtype=jnp.float32) * feature_stride

@@ -86,3 +86,9 @@ def test_example_train_ssd():
     out = _run("train_ssd.py", "--steps", "12", "--batch-size", "4",
                "--size", "64", timeout=500)
     assert "ssd training OK" in out
+
+
+def test_example_train_rcnn():
+    out = _run("train_rcnn.py", "--steps", "10", "--batch-size", "2",
+               timeout=500)
+    assert "rcnn training OK" in out

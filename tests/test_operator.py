@@ -730,3 +730,42 @@ def test_bf16_conv_backward_error_bounded_at_depth():
     # this is the quantitative backing for the "native-dtype backward is
     # acceptable" design note in ops/nn.py
     assert rel < 0.08, rel
+
+
+def test_nd_contrib_namespace_carries_detection_ops():
+    """The reference exposes _contrib_* ops as mx.nd.contrib.<Name>
+    (python/mxnet/ndarray/contrib.py); Proposal -> ROIAlign must chain
+    through that namespace (the rcnn example path)."""
+    import mxnet_tpu as mx
+
+    for name in ("Proposal", "ROIAlign", "box_nms",
+                 "DeformableConvolution"):
+        assert hasattr(mx.nd.contrib, name), name
+    rs = np.random.RandomState(0)
+    cls = mx.nd.array(rs.rand(1, 6, 4, 4))
+    bb = mx.nd.array(rs.randn(1, 12, 4, 4) * 0.1)
+    info = mx.nd.array([[64, 64, 1.0]])
+    rois = mx.nd.contrib.Proposal(
+        cls, bb, info, rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4,
+        feature_stride=16, scales=(8,), rpn_min_size=1)
+    assert rois.shape == (4, 5)
+    pooled = mx.nd.contrib.ROIAlign(
+        mx.nd.array(rs.randn(1, 8, 4, 4)), rois, pooled_size=(2, 2),
+        spatial_scale=1.0 / 16)
+    assert pooled.shape == (4, 8, 2, 2)
+
+
+def test_proposal_channel_anchor_mismatch_raises():
+    """scales x ratios defines the anchor count; a cls_prob whose channel
+    dim disagrees must fail loudly, not with a reshape error deep in the
+    kernel (found driving nd.contrib.Proposal with default scales)."""
+    import pytest
+
+    import mxnet_tpu as mx
+
+    cls = mx.nd.zeros((1, 6, 4, 4))   # 3 anchors' worth of channels
+    bb = mx.nd.zeros((1, 12, 4, 4))
+    info = mx.nd.array([[64, 64, 1.0]])
+    with pytest.raises(ValueError, match="channels"):
+        # default scales=(4,8,16,32) x ratios=(0.5,1,2) = 12 anchors
+        mx.nd.contrib.Proposal(cls, bb, info)
