@@ -1,5 +1,8 @@
 """Neural network layers (reference: python/mxnet/gluon/nn/)."""
 
+# reference exposes the Block family on gluon.nn too
+# (python/mxnet/gluon/nn/__init__.py re-exports ..block)
+from ..block import Block, HybridBlock, SymbolBlock
 from .activations import (Activation, ELU, GELU, LeakyReLU, PReLU, SELU,
                           Swish)
 from .basic_layers import (BatchNorm, Dense, Dropout, Embedding, Flatten,

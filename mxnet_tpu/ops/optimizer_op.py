@@ -167,6 +167,27 @@ def lars_update_pure(weight, grad, mom, lr, eta=0.001, momentum=0.9,
     return weight + mom, mom
 
 
+def ftml_update_pure(weight, grad, d, v, z, lr, t=1, beta1=0.6,
+                     beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                     clip_grad=-1.0):
+    """FTML — Follow The Moving Leader (reference: ftml_update kernel in
+    optimizer_op.cc ≥1.2; Zheng & Kwok 2017).  States: d (denominator),
+    v (second moment), z (leader accumulator); the reference folds wd
+    into the gradient BEFORE clipping (unlike sgd/adam where clip comes
+    first — same family of per-op quirks as adam_update's).  NOTE the
+    reference names its clip knob ``clip_grad`` on this one op (not
+    ``clip_gradient``)."""
+    grad = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        grad = jnp.clip(grad, -clip_grad, clip_grad)
+    v = beta2 * v + (1.0 - beta2) * jnp.square(grad)
+    d_t = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(v / (1.0 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1.0 - beta1) * grad - sigma * weight
+    return -z / d_t, d_t, v, z
+
+
 def lamb_update_phase1_pure(weight, grad, mean, var, t=1, beta1=0.9,
                             beta2=0.999, epsilon=1e-6, wd=0.0,
                             bias_correction=True, rescale_grad=1.0,
@@ -255,6 +276,7 @@ for _name, _fn in [
     ("adadelta_update", adadelta_update_pure),
     ("lars_update", lars_update_pure),
     ("mp_lars_update", mp_lars_update_pure),
+    ("ftml_update", ftml_update_pure),
     ("lamb_update_phase1", lamb_update_phase1_pure),
     ("lamb_update_phase2", lamb_update_phase2_pure),
     ("mp_sgd_update", mp_sgd_update_pure),
@@ -280,4 +302,5 @@ PURE_UPDATES = {
     "adagrad_update": adagrad_update_pure,
     "adadelta_update": adadelta_update_pure,
     "lars_update": lars_update_pure,
+    "ftml_update": ftml_update_pure,
 }

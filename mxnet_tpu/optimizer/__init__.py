@@ -4,8 +4,8 @@ from . import optimizer
 from . import lr_scheduler
 from .optimizer import (Optimizer, SGD, NAG, Adam, Adamax, Nadam, RMSProp,
                         AdaGrad, AdaDelta, Ftrl, Signum, SGLD, DCASGD, LAMB,
-                        LARS, AdamW, Test, Updater, get_updater, register,
-                        create)
+                        LARS, LBSGD, FTML, AdamW, Test, Updater, get_updater,
+                        register, create)
 from .lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
                            PolyScheduler, CosineScheduler)
 

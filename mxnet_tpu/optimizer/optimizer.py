@@ -713,6 +713,75 @@ class LARS(Optimizer):
 
 
 @register
+class FTML(Optimizer):
+    """Follow The Moving Leader (reference: FTML optimizer + ftml_update
+    kernel ≥1.2; Zheng & Kwok, ICML 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+
+        z = jnp.zeros(weight.shape, dtype=weight.dtype)
+        return tuple(_from_jax(jnp.zeros_like(z)) for _ in range(3))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common()
+        # reference quirk: ftml_update takes clip_grad, not clip_gradient
+        kw["clip_grad"] = kw.pop("clip_gradient", -1.0)
+        self._apply(_op.ftml_update_pure, weight, list(state), grad,
+                    lr=self._get_lr(index), wd=self._get_wd(index), t=t,
+                    beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, **kw)
+
+
+@register
+class LBSGD(LARS):
+    """Large-Batch SGD (reference: LBSGD optimizer ≥1.2): LARS layer-wise
+    adaptive rates plus an lr warmup schedule for the batch-scaled lr.
+
+    The reference's accounting knobs (``batch_scale``,
+    ``updates_per_epoch``, ``begin_epoch``/``num_epochs``) translate to:
+    effective lr ramps from ``learning_rate`` to ``learning_rate *
+    batch_scale`` over ``warmup_epochs * updates_per_epoch`` updates,
+    by the chosen ``warmup_strategy`` ('linear'|'power2'|'sqrt';
+    anything else disables warmup).  ``begin_epoch``/``num_epochs`` are
+    accepted for reference signature compatibility only — they fed the
+    reference's internal epoch bookkeeping, which ``updates_per_epoch``
+    already determines here."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.batch_scale = float(batch_scale)
+        self.warmup_updates = max(1, int(warmup_epochs)
+                                  * max(1, int(updates_per_epoch)))
+
+    def _get_lr(self, index):
+        lr = super()._get_lr(index)
+        t = max(self._index_update_count.get(index, 1), 1)
+        frac = min(t / self.warmup_updates, 1.0)
+        if self.warmup_strategy == "linear":
+            pass
+        elif self.warmup_strategy == "power2":
+            frac = frac * frac
+        elif self.warmup_strategy == "sqrt":
+            frac = frac ** 0.5
+        else:
+            return lr * self.batch_scale
+        return lr * (1.0 + frac * (self.batch_scale - 1.0))
+
+
+@register
 class AdamW(Optimizer):
     """Adam with decoupled weight decay (reference: contrib.AdamW)."""
 

@@ -452,6 +452,7 @@ SPECS = {
     "adagrad_update": _opt(1),
     "lars_update": _opt(1, momentum=0.9, eta=0.01),
     "mp_lars_update": _opt(1, mp=True, momentum=0.9, eta=0.01),
+    "ftml_update": _opt(3, t=1),
     "adadelta_update": Spec([N(5), N(5), np.zeros(5, np.float32),
                              np.zeros(5, np.float32)], {"rho": 0.9}),
     "lamb_update_phase1": Spec([N(5), N(5), np.zeros(5, np.float32),

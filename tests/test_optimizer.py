@@ -144,6 +144,49 @@ def test_lars_oracle_and_bias_path():
         b.asnumpy(), b0 - lr * (0.5 + wd * b0), rtol=1e-6)
 
 
+def test_ftml_oracle():
+    """ftml_update against a numpy oracle of the FTML recurrence
+    (Zheng & Kwok 2017), two steps so d_{t-1}/z carry-over is checked."""
+    rs = np.random.RandomState(1)
+    w0 = rs.uniform(-1, 1, (5, 2)).astype(np.float32)
+    lr, b1, b2, eps, wd, clip = 0.01, 0.6, 0.999, 1e-8, 0.001, 0.5
+    w = _nd(w0.copy())
+    o = opt.FTML(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps, wd=wd,
+                 clip_gradient=clip)
+    st = o.create_state(0, w)
+    wn = w0.copy()
+    d = v = z = np.zeros_like(w0)
+    for t in (1, 2):
+        g0 = rs.uniform(-1, 1, (5, 2)).astype(np.float32)
+        o.update(0, w, _nd(g0), st)
+        # ftml folds wd in BEFORE clipping (reference kernel order)
+        grad = np.clip(g0 + wd * wn, -clip, clip)
+        v = b2 * v + (1 - b2) * grad ** 2
+        d_t = (1 - b1 ** t) / lr * (np.sqrt(v / (1 - b2 ** t)) + eps)
+        sigma = d_t - b1 * d
+        z = b1 * z + (1 - b1) * grad - sigma * wn
+        wn = -z / d_t
+        d = d_t
+        np.testing.assert_allclose(w.asnumpy(), wn, rtol=1e-5, atol=1e-6)
+
+
+def test_lbsgd_warmup_scales_lr():
+    """LBSGD = LARS + warmup: the effective lr ramps linearly to
+    lr*batch_scale over warmup_epochs*updates_per_epoch updates."""
+    o = opt.LBSGD(learning_rate=0.1, momentum=0.9, batch_scale=4,
+                  warmup_strategy="linear", warmup_epochs=1,
+                  updates_per_epoch=10)
+    assert isinstance(o, opt.LARS)
+    w = _nd(np.ones((3, 2)))
+    st = o.create_state(0, w)
+    o.update(0, w, _nd(np.full((3, 2), 0.1, np.float32)), st)
+    assert o._get_lr(0) == pytest.approx(0.1 * (1 + 0.1 * 3))  # t=1/10
+    for _ in range(20):
+        o.update(0, w, _nd(np.full((3, 2), 0.1, np.float32)), st)
+    assert o._get_lr(0) == pytest.approx(0.4)  # fully warmed: lr*scale
+    assert np.all(np.isfinite(w.asnumpy()))
+
+
 def test_multi_precision_master_weights():
     w = _nd(np.ones((5,))).astype(np.float16)
     g = _nd(np.full((5,), 0.1)).astype(np.float16)
