@@ -53,7 +53,7 @@ for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "parallel", "test_utils", "util", "visualization", "operator",
              "symbol", "model", "module", "lr_scheduler", "distributed",
              "amp", "checkpoint", "contrib", "rtc", "image_detection",
-             "subgraph"):
+             "subgraph", "attribute"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:
@@ -75,3 +75,5 @@ if "module" in globals():
     mod = globals()["module"]
 if "visualization" in globals():
     viz = globals()["visualization"]
+if "attribute" in globals():
+    AttrScope = attribute.AttrScope

@@ -1,0 +1,46 @@
+"""Attribute scoping for symbol construction (reference:
+python/mxnet/attribute.py — ``mx.AttrScope``).
+
+``with mx.AttrScope(ctx_group='stage1', lr_mult='0.1'):`` attaches the
+given attributes to every Symbol node created inside the scope — the
+mechanism the reference's ``group2ctx`` model-parallel placement and
+per-layer lr/wd multipliers ride on.  Here the attrs land in the node's
+``_attr_dict`` (readable via ``Symbol.attr``; ``subgraph.py`` partition
+properties and ``module`` lr_mult handling consume them).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class _Local(threading.local):
+    def __init__(self):
+        self.stack: list = []
+
+
+_LOCAL = _Local()
+
+
+class AttrScope:
+    """Scope attributes applied to symbols created within (nestable;
+    inner scopes override outer keys)."""
+
+    def __init__(self, **kwargs):
+        self._attr = {k: v for k, v in kwargs.items() if v is not None}
+
+    def __enter__(self):
+        merged = dict(current_attrs())
+        merged.update(self._attr)
+        _LOCAL.stack.append(merged)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        _LOCAL.stack.pop()
+        return False
+
+
+def current_attrs():
+    """The attr dict the innermost active scope contributes ({} if no
+    scope is active)."""
+    return _LOCAL.stack[-1] if _LOCAL.stack else {}

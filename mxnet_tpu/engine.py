@@ -59,3 +59,15 @@ def bulk(size: int | None = None):
     import contextlib
 
     return contextlib.nullcontext()
+
+
+def set_bulk_size(size):
+    """Reference: mx.engine.set_bulk_size (MXEngineSetBulkSize) — sets
+    the async-engine op-bulking window and returns the previous value.
+    Under XLA the whole jitted step IS one bulk (CachedOp compiles the
+    full graph), so the knob has nothing to tune: accepted for API
+    compatibility, returns the previous (nominal) value."""
+    global _BULK_SIZE
+    prev = globals().setdefault("_BULK_SIZE", 15)
+    _BULK_SIZE = int(size)
+    return prev

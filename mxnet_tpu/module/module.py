@@ -125,7 +125,8 @@ class Module(BaseModule):
                 optimizer_params["rescale_grad"] = \
                     1.0 / getattr(self, "_batch_size", 1)
             optimizer = opt_mod.create(
-                optimizer, param_idx2name=idx2name, **optimizer_params)
+                optimizer, param_idx2name=idx2name, sym=self._symbol,
+                **optimizer_params)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
         if kvstore:

@@ -57,7 +57,10 @@ class Optimizer:
             raise ValueError("param_idx2name should be a dict of param "
                              "indexes to names.")
         self.idx2name = param_idx2name.copy()
-        self.sym_info = ()
+        # reference: sym carries per-variable __lr_mult__/__wd_mult__
+        # attrs (AttrScope / var(lr_mult=...)) that set_lr_mult consults
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
+            if sym is not None else ()
         self.param_dict = param_dict if param_dict else {}
         self.set_lr_mult({})
         self.set_wd_mult({})
@@ -124,13 +127,25 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    @staticmethod
+    def _sym_mult(attrs, key):
+        """Per-variable multiplier attr: the reference stores the dunder
+        form (__lr_mult__); our var(lr_mult=...) stores the plain key —
+        accept both."""
+        if f"__{key}__" in attrs:
+            return float(attrs[f"__{key}__"])
+        if key in attrs:
+            return float(attrs[key])
+        return None
+
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = {}
         if self.sym_info:
             attr, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+                m = self._sym_mult(attr.get(name, {}), "lr_mult")
+                if m is not None:
+                    self.lr_mult[name] = m
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
@@ -142,8 +157,9 @@ class Optimizer:
         if self.sym_info:
             attr, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+                m = self._sym_mult(attr.get(name, {}), "wd_mult")
+                if m is not None:
+                    self.wd_mult[name] = m
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
@@ -727,8 +743,10 @@ class FTML(Optimizer):
     def create_state(self, index, weight):
         import jax.numpy as jnp
 
-        z = jnp.zeros(weight.shape, dtype=weight.dtype)
-        return tuple(_from_jax(jnp.zeros_like(z)) for _ in range(3))
+        # d, v, z
+        return tuple(
+            _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype))
+            for _ in range(3))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -761,6 +779,15 @@ class LBSGD(LARS):
                  warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
                  begin_epoch=0, num_epochs=60, **kwargs):
         super().__init__(momentum=momentum, **kwargs)
+        # 'lars' (a reference-valid strategy whose ramp follows the lars
+        # coefficients) is approximated by the linear ramp; unknown
+        # strategies must not silently jump to the full scaled lr
+        if warmup_strategy == "lars":
+            warmup_strategy = "linear"
+        elif warmup_strategy not in ("linear", "power2", "sqrt", None):
+            raise MXNetError(
+                f"LBSGD: unknown warmup_strategy {warmup_strategy!r} "
+                f"(expected linear|power2|sqrt|lars|None)")
         self.warmup_strategy = warmup_strategy
         self.batch_scale = float(batch_scale)
         self.warmup_updates = max(1, int(warmup_epochs)

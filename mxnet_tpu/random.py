@@ -84,3 +84,30 @@ def in_key_scope() -> bool:
 # numpy-compatible helpers used across the frontend
 def np_seed(seed_state):
     _np.random.seed(seed_state)
+
+
+# -- module-level samplers (reference: python/mxnet/random.py delegates
+# to the ndarray.random generated wrappers) --------------------------------
+
+def _delegate(name):
+    def fn(*args, **kwargs):
+        from .ndarray import random as _ndr
+
+        return getattr(_ndr, name)(*args, **kwargs)
+
+    fn.__name__ = name
+    fn.__doc__ = f"mx.random.{name} (delegates to mx.nd.random.{name})"
+    return fn
+
+
+uniform = _delegate("uniform")
+normal = _delegate("normal")
+randn = _delegate("randn")
+randint = _delegate("randint")
+poisson = _delegate("poisson")
+exponential = _delegate("exponential")
+gamma = _delegate("gamma")
+multinomial = _delegate("multinomial")
+negative_binomial = _delegate("negative_binomial")
+generalized_negative_binomial = _delegate("generalized_negative_binomial")
+shuffle = _delegate("shuffle")

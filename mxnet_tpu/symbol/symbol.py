@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 
+from ..attribute import current_attrs as _current_attrs
 from ..base import MXNetError
 from ..ops import registry as _registry
 
@@ -69,7 +70,10 @@ class Symbol:
         return tuple(s)
 
     def _set_attr(self, **kwargs):
-        self._attr_dict.update(kwargs)
+        # skip Nones: var()'s absent kwarg defaults must not clobber
+        # AttrScope-provided values (lr_mult etc.)
+        self._attr_dict.update(
+            {k: v for k, v in kwargs.items() if v is not None})
 
     def __getitem__(self, index):
         if not isinstance(index, int):
@@ -156,6 +160,18 @@ class Symbol:
     def list_auxiliary_states(self):
         return [n.name for n in self._topo()
                 if n.op is None and n._attr_dict.get("__aux__")]
+
+    def attr_dict(self):
+        """{node_name: {attr: str(value)}} over the whole graph
+        (reference: Symbol.attr_dict; what Optimizer.sym_info reads for
+        __lr_mult__/__wd_mult__)."""
+        out = {}
+        for n in self._topo():
+            attrs = {k: str(v)
+                     for k, v in _json_safe_attrs(n._attr_dict).items()}
+            if attrs:
+                out[n.name] = attrs
+        return out
 
     def list_inputs(self):
         return [n.name for n in self._topo()
@@ -341,14 +357,22 @@ class Symbol:
         for i, n in enumerate(order):
             if n.op is None:
                 arg_nodes.append(i)
-            nodes.append({
+            entry = {
                 "op": "null" if n.op is None else n.op,
                 "name": n.name,
                 "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
                           for k, v in n.attrs.items()},
                 "inputs": [[index[id(s)], s.out_index, 0]
                            for s in n.inputs],
-            })
+            }
+            # node-level user attrs (AttrScope / var(lr_mult=...)):
+            # the reference serializes these in symbol.json; only plain
+            # scalar values qualify — subgraph bookkeeping (Symbol
+            # lists, jit caches) and init objects stay runtime-only
+            user = _json_safe_attrs(n._attr_dict)
+            if user:
+                entry["node_attrs"] = user
+            nodes.append(entry)
         heads = [[index[id(self)], self.out_index, 0]]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
                            "node_row_ptr": list(range(len(nodes) + 1)),
@@ -511,6 +535,9 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         dtype=None, init=None, stype=None, **kwargs):
     """mx.sym.Variable (reference: symbol.var)."""
     s = Symbol(None, name, [], {})
+    scope = _current_attrs()
+    if scope:
+        s._set_attr(**scope)
     s._set_attr(shape=shape, lr_mult=lr_mult, wd_mult=wd_mult,
                 dtype=dtype, init=init, **(attr or {}))
     return s
@@ -606,7 +633,11 @@ def apply_op(opname, *sym_inputs, name=None, **kwargs):
         kwargs["__kw_inputs__"] = [k for k, _ in kw_syms]
         inputs += [v for _, v in kw_syms]
     # multi-output ops: reflected lazily when indexing
-    return Symbol(opname, nm, inputs, kwargs)
+    out = Symbol(opname, nm, inputs, kwargs)
+    scope = _current_attrs()
+    if scope:
+        out._set_attr(**scope)
+    return out
 
 
 def load(fname):
@@ -616,36 +647,60 @@ def load(fname):
     return fromjson(data)
 
 
+_INTERNAL_ATTRS = {"__aux__", "__null__", "__scalar__", "__kw_inputs__"}
+
+
+def _json_safe_attrs(attr_dict):
+    """USER node attrs only: plain scalar values, minus the internal
+    markers and subgraph/runtime bookkeeping (Symbol lists, init
+    objects, jit caches — anything non-primitive)."""
+    return {k: v for k, v in attr_dict.items()
+            if k not in _INTERNAL_ATTRS
+            and isinstance(v, (str, int, float, bool))}
+
+
 def fromjson(data):
+    from ..attribute import _LOCAL as _attr_local
+
     if isinstance(data, str):
         data = json.loads(data)
     nodes = data["nodes"]
     built = []
-    for nd in nodes:
-        attrs = {}
-        for k, v in nd.get("attrs", {}).items():
-            try:
-                attrs[k] = json.loads(v)
-            except (json.JSONDecodeError, TypeError):
-                attrs[k] = v
-        if nd["op"] == "null":
-            v = var(nd["name"])
-            # restore variable-level attrs (__scalar__ values, __aux__
-            # markers) so save/load round-trips evaluation semantics
-            v.attrs.update(attrs)
-            if attrs.get("__aux__"):
-                v._set_attr(__aux__=True)
-            built.append(v)
-        elif nd["op"] == "_group":
-            # rebuild as a real Group: keeps multi-output count and the
-            # specialized per-output eval
-            built.append(Group(
-                [built[i][oi] if oi else built[i]
-                 for i, oi, _ in nd["inputs"]]))
-        else:
-            inputs = [built[i][oi] for i, oi, _ in nd["inputs"]]
-            sym = apply_op(nd["op"], *inputs, name=nd["name"], **attrs)
-            built.append(sym)
+    # deserialization must NOT stamp an ambient AttrScope onto loaded
+    # nodes (the reference JSON loader bypasses AttrScope): suspend it
+    saved_scope, _attr_local.stack = _attr_local.stack, []
+    try:
+        for nd in nodes:
+            attrs = {}
+            for k, v in nd.get("attrs", {}).items():
+                try:
+                    attrs[k] = json.loads(v)
+                except (json.JSONDecodeError, TypeError):
+                    attrs[k] = v
+            if nd["op"] == "null":
+                v = var(nd["name"])
+                # restore variable-level attrs (__scalar__ values,
+                # __aux__ markers) so save/load round-trips evaluation
+                # semantics
+                v.attrs.update(attrs)
+                if attrs.get("__aux__"):
+                    v._set_attr(__aux__=True)
+                built.append(v)
+            elif nd["op"] == "_group":
+                # rebuild as a real Group: keeps multi-output count and
+                # the specialized per-output eval
+                built.append(Group(
+                    [built[i][oi] if oi else built[i]
+                     for i, oi, _ in nd["inputs"]]))
+            else:
+                inputs = [built[i][oi] for i, oi, _ in nd["inputs"]]
+                sym = apply_op(nd["op"], *inputs, name=nd["name"],
+                               **attrs)
+                built.append(sym)
+            if nd.get("node_attrs"):
+                built[-1]._set_attr(**nd["node_attrs"])
+    finally:
+        _attr_local.stack = saved_scope
     head, oi, _ = data["heads"][0]
     return built[head][oi] if oi else built[head]
 

@@ -279,3 +279,104 @@ def test_export_import_transformers(tmp_path):
             np.testing.assert_allclose(b.asnumpy(), a.asnumpy(),
                                        rtol=1e-4, atol=1e-4,
                                        err_msg=name)
+
+
+def test_attr_scope_applies_to_symbols():
+    """mx.AttrScope attaches attrs to every node created in scope
+    (reference: python/mxnet/attribute.py; the group2ctx /
+    per-layer-lr_mult mechanism)."""
+    import mxnet_tpu as mx
+
+    with mx.AttrScope(ctx_group="stage1", lr_mult="0.1"):
+        a = mx.sym.var("a")
+        b = mx.sym.relu(a)
+        # var()'s own (absent) lr_mult kwarg must NOT clobber the scope
+        assert a.attr("lr_mult") == "0.1"
+        with mx.AttrScope(ctx_group="stage2"):  # inner overrides
+            c = mx.sym.exp(b)
+    d = mx.sym.log(c)  # outside: no scope attrs
+    assert a.attr("ctx_group") == "stage1"
+    assert b.attr("ctx_group") == "stage1"
+    assert b.attr("lr_mult") == "0.1"
+    assert c.attr("ctx_group") == "stage2"
+    assert c.attr("lr_mult") == "0.1"
+    assert d.attr("ctx_group") is None
+
+
+def test_module_level_random_and_bulk_size():
+    """mx.random.uniform/normal (module level, reference random.py) and
+    mx.engine.set_bulk_size exist and behave."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    mx.random.seed(7)
+    u = mx.random.uniform(0, 1, shape=(100,))
+    n = mx.random.normal(0, 1, shape=(100,))
+    assert u.shape == (100,) and n.shape == (100,)
+    un = u.asnumpy()
+    assert (un >= 0).all() and (un <= 1).all()
+    assert abs(float(np.mean(n.asnumpy()))) < 0.5
+    prev = mx.engine.set_bulk_size(30)
+    assert isinstance(prev, int)
+    assert mx.engine.set_bulk_size(prev) == 30
+
+
+def test_attr_scope_lr_mult_freezes_layer_in_module():
+    """End-to-end AttrScope -> Optimizer.sym_info: a variable created
+    under AttrScope(lr_mult='0.0') must not move during Module training
+    (reference: Optimizer.set_lr_mult reading __lr_mult__ symbol
+    attrs)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    x = mx.sym.var("data")
+    with mx.AttrScope(lr_mult="0.0"):
+        frozen_w = mx.sym.var("frozen_weight")
+    h = mx.sym.FullyConnected(x, frozen_w, None, num_hidden=4,
+                              no_bias=True, name="fc1")
+    out = mx.sym.FullyConnected(h, mx.sym.var("fc2_weight"), None,
+                                num_hidden=1, no_bias=True, name="fc2")
+    loss = mx.sym.MakeLoss(mx.sym.mean(mx.sym.square(out)))
+
+    mod = mx.mod.Module(loss, data_names=("data",), label_names=())
+    batch = mx.io.DataBatch(data=[mx.nd.array(
+        np.random.RandomState(0).randn(8, 6).astype(np.float32))])
+    mod.bind(data_shapes=[("data", (8, 6))], label_shapes=None)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    assert mod._optimizer.lr_mult.get("frozen_weight") == 0.0
+    w0 = mod._exec.arg_dict["frozen_weight"].asnumpy().copy()
+    f0 = mod._exec.arg_dict["fc2_weight"].asnumpy().copy()
+    for _ in range(3):
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    np.testing.assert_array_equal(
+        mod._exec.arg_dict["frozen_weight"].asnumpy(), w0)
+    assert not np.allclose(mod._exec.arg_dict["fc2_weight"].asnumpy(), f0)
+
+
+def test_node_attrs_survive_json_roundtrip(tmp_path):
+    """AttrScope/lr_mult node attrs serialize into symbol.json and a
+    load inside an ACTIVE AttrScope must not stamp the ambient scope
+    onto loaded nodes (reference loader bypasses AttrScope)."""
+    x = mx.sym.var("data")
+    with mx.AttrScope(lr_mult="0.0", ctx_group="s1"):
+        w = mx.sym.var("w")
+    y = mx.sym.FullyConnected(x, w, None, num_hidden=2, no_bias=True,
+                              name="fc")
+    f = str(tmp_path / "net-symbol.json")
+    y.save(f)
+    y2 = mx.sym.load(f)
+    ad = y2.attr_dict()
+    assert ad["w"]["lr_mult"] == "0.0"
+    assert ad["w"]["ctx_group"] == "s1"
+    assert "lr_mult" not in ad.get("data", {})
+    # ambient scope must not leak into deserialized nodes
+    with mx.AttrScope(lr_mult="9.9"):
+        y3 = mx.sym.load(f)
+    assert y3.attr_dict()["w"]["lr_mult"] == "0.0"
+    assert "lr_mult" not in y3.attr_dict().get("data", {})

@@ -27,10 +27,17 @@ run_step() {  # $1 = label, $2 = timeout, rest = command
     local label=$1 budget=$2; shift 2
     log "start $label (budget ${budget}s)"
     local t0=$SECONDS
-    timeout "$budget" "$@" > /tmp/chip_step.out 2>> "$LOG"
+    local out
+    out=$(mktemp) || return 1
+    timeout "$budget" "$@" > "$out" 2>> "$LOG"
     local rc=$?
     local line
-    line=$(grep -E '^\{' /tmp/chip_step.out | tail -1)
+    line=$(grep -E '^\{' "$out" | tail -1)
+    rm -f "$out"
+    # only embed verified JSON (a budget kill can truncate mid-write)
+    if [ -n "$line" ] && ! python -c 'import json,sys; json.loads(sys.argv[1])' "$line" 2>/dev/null; then
+        line=""
+    fi
     if [ -n "$line" ]; then
         echo "{\"step\": \"$label\", \"rc\": $rc, \"secs\": $((SECONDS-t0)), \"result\": $line}" >> "$OUT"
     else
