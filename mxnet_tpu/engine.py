@@ -61,6 +61,9 @@ def bulk(size: int | None = None):
     return contextlib.nullcontext()
 
 
+_BULK_SIZE = 15  # reference default engine bulking window
+
+
 def set_bulk_size(size):
     """Reference: mx.engine.set_bulk_size (MXEngineSetBulkSize) — sets
     the async-engine op-bulking window and returns the previous value.
@@ -68,6 +71,6 @@ def set_bulk_size(size):
     full graph), so the knob has nothing to tune: accepted for API
     compatibility, returns the previous (nominal) value."""
     global _BULK_SIZE
-    prev = globals().setdefault("_BULK_SIZE", 15)
+    prev = _BULK_SIZE
     _BULK_SIZE = int(size)
     return prev

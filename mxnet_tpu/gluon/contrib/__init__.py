@@ -1,5 +1,7 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
 
+from . import cnn
+from . import data
 from . import nn
 from . import rnn
 from . import moe

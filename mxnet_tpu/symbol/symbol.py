@@ -366,12 +366,21 @@ class Symbol:
                            for s in n.inputs],
             }
             # node-level user attrs (AttrScope / var(lr_mult=...)):
-            # the reference serializes these in symbol.json; only plain
-            # scalar values qualify — subgraph bookkeeping (Symbol
-            # lists, jit caches) and init objects stay runtime-only
+            # the reference serializes these in the node's "attrs" dict;
+            # only plain scalar values qualify — subgraph bookkeeping
+            # (Symbol lists, jit caches) and init objects stay
+            # runtime-only.  Variables have no op kwargs, so merging
+            # into "attrs" is collision-free AND upstream-readable; op
+            # nodes keep user attrs under "node_attrs" (merging would
+            # corrupt their op kwargs on reload)
             user = _json_safe_attrs(n._attr_dict)
             if user:
-                entry["node_attrs"] = user
+                if n.op is None:
+                    entry["attrs"] = {**{k: str(v)
+                                         for k, v in user.items()},
+                                      **entry["attrs"]}
+                else:
+                    entry["node_attrs"] = user
             nodes.append(entry)
         heads = [[index[id(self)], self.out_index, 0]]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
@@ -685,6 +694,13 @@ def fromjson(data):
                 v.attrs.update(attrs)
                 if attrs.get("__aux__"):
                     v._set_attr(__aux__=True)
+                # user attrs (lr_mult/__lr_mult__/ctx_group...) live in
+                # the variable's "attrs" dict in the reference format —
+                # surface them in _attr_dict so attr_dict()/sym_info
+                # sees them on upstream-exported files too
+                user = _json_safe_attrs(attrs)
+                if user:
+                    v._set_attr(**user)
                 built.append(v)
             elif nd["op"] == "_group":
                 # rebuild as a real Group: keeps multi-output count and

@@ -275,3 +275,68 @@ def scale2(x):
         mx.rtc.CudaModule("__global__ void k() {}")
     with pytest.raises(mx.base.MXNetError):
         mx.rtc.PallasModule("x = 1", exports=["missing"])
+
+
+def test_contrib_deformable_convolution_layer():
+    """gluon.contrib.cnn.DeformableConvolution (reference:
+    gluon/contrib/cnn/conv_layers.py): zero-init offsets make it exactly
+    a regular convolution; offsets train."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    layer = DeformableConvolution(8, kernel_size=3, padding=1,
+                                  num_deformable_group=2)
+    layer.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 4, 10, 10)
+                    .astype(np.float32))
+    out = layer(x)
+    assert out.shape == (2, 8, 10, 10)
+    # zero offsets (the init) == plain convolution with the same weights
+    ref = mx.nd.Convolution(
+        x, layer.weight.data(), layer.bias.data(), kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1), dilate=(1, 1), num_filter=8,
+        num_group=1, no_bias=False)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+    # gradients flow to the offset branch once offsets matter
+    with autograd.record():
+        loss = (layer(x) * mx.nd.array(
+            np.random.RandomState(1).rand(2, 8, 10, 10)
+            .astype(np.float32))).sum()
+    loss.backward()
+    gw = layer.offset_weight.grad().asnumpy()
+    assert np.isfinite(gw).all() and np.abs(gw).sum() > 0
+
+
+def test_contrib_data_sampler_and_text():
+    """gluon.contrib.data: IntervalSampler index pattern and the local
+    CharTokenDataset LM windows + DataLoader integration."""
+    import numpy as np
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.data import (CharTokenDataset,
+                                              IntervalSampler)
+
+    s = IntervalSampler(10, 3)
+    idx = list(s)
+    assert idx[:4] == [0, 3, 6, 9] and len(idx) == len(s) == 10
+    assert sorted(idx) == list(range(10))
+    assert list(IntervalSampler(10, 3, rollover=False)) == [0, 3, 6, 9]
+
+    text = "hello tpu world, " * 40
+    ds = CharTokenDataset(text, seq_len=16)
+    x0, y0 = ds[0]
+    assert x0.shape == (16,) and y0.shape == (16,)
+    # target is input shifted by one token
+    assert (x0[1:] == y0[:-1]).all()
+    decoded = "".join(ds.inv_vocab[int(i)] for i in x0)
+    assert decoded == text[:16]
+    loader = gluon.data.DataLoader(ds, batch_size=4,
+                                   sampler=IntervalSampler(len(ds), 2))
+    xb, yb = next(iter(loader))
+    assert xb.shape == (4, 16)

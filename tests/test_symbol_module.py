@@ -380,3 +380,14 @@ def test_node_attrs_survive_json_roundtrip(tmp_path):
         y3 = mx.sym.load(f)
     assert y3.attr_dict()["w"]["lr_mult"] == "0.0"
     assert "lr_mult" not in y3.attr_dict().get("data", {})
+    # upstream-MXNet format: dunder user attrs in a variable's "attrs"
+    # dict must surface in attr_dict() (Optimizer.sym_info interop)
+    import json as _json
+
+    doc = _json.loads(y.tojson())
+    wnode = next(n for n in doc["nodes"] if n["name"] == "w")
+    assert wnode["attrs"]["lr_mult"] == "0.0"  # serialized in-format
+    wnode["attrs"]["__lr_mult__"] = "0.25"
+    del wnode["attrs"]["lr_mult"]
+    y4 = mx.sym.fromjson(_json.dumps(doc))
+    assert y4.attr_dict()["w"]["__lr_mult__"] == "0.25"
