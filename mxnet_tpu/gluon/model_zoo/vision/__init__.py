@@ -10,6 +10,7 @@ from .mobilenet import *
 from .resnet import *
 from .squeezenet import *
 from .vgg import *
+from .vit import *
 
 
 def get_model(name, **kwargs):
@@ -35,6 +36,8 @@ def get_model(name, **kwargs):
         "mobilenetv2_0.75": mobilenet_v2_0_75,
         "mobilenetv2_0.5": mobilenet_v2_0_5,
         "mobilenetv2_0.25": mobilenet_v2_0_25,
+        "vit_tiny": vit_tiny, "vit_small": vit_small,
+        "vit_base": vit_base, "vit_large": vit_large,
     }
     name = name.lower()
     if name not in models:

@@ -597,3 +597,53 @@ def test_gpt_beam_generate():
     assert (s4 >= s1 - 1e-5).all(), (s1, s4)
     # on a learned deterministic pattern the wide beam agrees too
     np.testing.assert_array_equal(b4.asnumpy(), greedy)
+
+
+def test_vit_forward_and_trains():
+    """VisionTransformer: patchify + scanned pre-LN trunk + cls head;
+    hybridized training drops loss; scan and per-layer trunks agree
+    in architecture (forward shapes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = vision.get_model("vit_tiny")
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 3, 32, 32)
+                    .astype(np.float32))
+    assert net(x).shape == (2, 10)
+    unscanned = vision.vit_tiny(scan_layers=False)
+    unscanned.initialize(init=mx.init.Xavier())
+    assert unscanned(x).shape == (2, 10)
+    # deploy path: shape-free hybrid_forward must trace symbolically
+    import os
+    import tempfile
+
+    net.hybridize()
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x)
+    d = tempfile.mkdtemp()
+    net.export(os.path.join(d, "vit"))
+    sb = gluon.SymbolBlock.imports(
+        os.path.join(d, "vit-symbol.json"), ["data"],
+        os.path.join(d, "vit-0000.params"))
+    np.testing.assert_allclose(sb(x).asnumpy(), ref.asnumpy(),
+                               atol=1e-5)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adamw",
+                       {"learning_rate": 1e-3})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    y = mx.nd.array(np.array([1.0, 7.0], np.float32))
+    first = last = None
+    for _ in range(10):
+        with autograd.record():
+            l = lf(net(x), y)
+        l.backward()
+        tr.step(2)
+        v = float(l.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
