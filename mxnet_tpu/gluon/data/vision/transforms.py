@@ -127,6 +127,26 @@ class CenterCrop(_HostTransform):
         return image.center_crop_np(arr, self._size, self._interpolation)
 
 
+class CropResize(_HostTransform):
+    """Crop the fixed region (x, y, w, h) then optionally resize to
+    ``size`` (reference: transforms.CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (int(x), int(y), int(width), int(height))
+        if size is not None and not isinstance(size, (tuple, list)):
+            size = (size, size)
+        self._size = tuple(size) if size is not None else None
+        self._interpolation = interpolation
+
+    def _apply(self, arr):
+        from .... import image
+
+        x, y, w, h = self._box
+        return image.fixed_crop_np(arr, x, y, w, h, size=self._size,
+                                   interp=self._interpolation)
+
+
 class RandomResizedCrop(_HostTransform):
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
                  interpolation=1):

@@ -468,6 +468,12 @@ def test_vision_transforms():
                                transforms.ToTensor()])
     assert comp(img).shape == (3, 8, 8)
 
+    cr = transforms.CropResize(2, 1, 6, 4)
+    np.testing.assert_array_equal(cr(img).asnumpy(),
+                                  img.asnumpy()[1:5, 2:8])
+    assert transforms.CropResize(2, 1, 6, 4,
+                                 size=(3, 2))(img).shape == (2, 3, 3)
+
 
 # -- detection pipeline (reference: python/mxnet/image/detection.py) -----------
 
