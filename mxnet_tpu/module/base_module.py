@@ -47,6 +47,14 @@ class BaseModule:
     def init_optimizer(self, *args, **kwargs):
         raise NotImplementedError()
 
+    def install_monitor(self, mon):
+        """Attach a mx.monitor.Monitor to this module's executor(s)
+        (reference: BaseModule.install_monitor)."""
+        exe = getattr(self, "_exec", None)
+        if exe is None:
+            raise RuntimeError("install_monitor requires a bound module")
+        mon.install(exe)
+
     # -- training loop ---------------------------------------------------------
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
@@ -133,6 +141,8 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=dict(optimizer_params))
+        if monitor is not None:
+            self.install_monitor(monitor)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -144,8 +154,12 @@ class BaseModule:
             nbatch = 0
             train_data.reset()
             for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = BatchEndParam(epoch=epoch, nbatch=nbatch,

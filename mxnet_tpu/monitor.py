@@ -1,0 +1,98 @@
+"""Monitor — per-layer output statistics during training (reference:
+python/mxnet/monitor.py; installed via ``Module.fit(monitor=...)``).
+
+Reference mechanism: a callback hooked into every op execution
+(MXExecutorSetMonitorCallback) collects outputs between ``tic()`` and
+``toc()``.  Under XLA the whole graph is ONE compiled program with no
+per-op callbacks, so the TPU-native Monitor evaluates the matching
+interior nodes eagerly from the executor's current arguments at
+``toc()`` time — same statistics (arguments don't change between the
+monitored forward and toc), debugging-priced (extra eager evaluation;
+install only while diagnosing, exactly like the reference's advice).
+"""
+
+from __future__ import annotations
+
+import re
+
+from .ndarray.ndarray import NDArray, _from_jax
+
+
+def _default_stat(x):
+    import jax.numpy as jnp
+
+    return jnp.abs(x).mean()
+
+
+class Monitor:
+    """Collect per-node output statistics every ``interval`` batches.
+
+    Parameters mirror the reference: ``interval`` (batches between
+    collections), ``stat_func`` (raw-array → scalar, default mean |x|),
+    ``pattern`` (regex on node names), ``sort`` (sort results by name).
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*",
+                 sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._exes = []
+
+    def install(self, exe):
+        """Register an executor to monitor (reference: install on every
+        executor in the group)."""
+        if exe not in self._exes:
+            self._exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def _interior_nodes(self, exe):
+        sym = exe._symbol
+        return [n for n in sym._topo()
+                if n.op is not None and self.re_pattern.match(n.name)]
+
+    def toc(self):
+        """Collect stats from all installed executors; returns a list of
+        (step, node_name, stat) with stat an NDArray scalar."""
+        if not self.activated:
+            return []
+        res = []
+        for exe in self._exes:
+            env = {name: arr._data
+                   for name, arr in exe.arg_dict.items()}
+            env.update({name: arr._data
+                        for name, arr in exe.aux_dict.items()})
+            for node in self._interior_nodes(exe):
+                try:
+                    outs = node.eval_raw(**env)
+                except Exception:
+                    continue  # heads needing absent inputs (labels etc.)
+                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                for i, o in enumerate(outs):
+                    name = node.name + (f"_output{i}" if len(outs) > 1
+                                        else "_output")
+                    res.append((self.step, name,
+                                _from_jax(self.stat_func(o))))
+        self.activated = False
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = res
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference: toc_print)."""
+        import logging
+
+        for step, name, stat in self.toc():
+            val = stat.asnumpy() if isinstance(stat, NDArray) else stat
+            logging.info("Batch: %7d %30s %s", step, name, str(val))

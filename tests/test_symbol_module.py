@@ -391,3 +391,41 @@ def test_node_attrs_survive_json_roundtrip(tmp_path):
     del wnode["attrs"]["lr_mult"]
     y4 = mx.sym.fromjson(_json.dumps(doc))
     assert y4.attr_dict()["w"]["__lr_mult__"] == "0.25"
+
+
+def test_monitor_collects_layer_stats():
+    """mx.monitor.Monitor through Module.fit(monitor=...) (reference:
+    python/mxnet/monitor.py): interval-gated collection of per-node
+    output stats, pattern filtering."""
+    import logging
+
+    mon = mx.monitor.Monitor(interval=2, pattern=".*fc.*")
+    rng = np.random.RandomState(0)
+    data = rng.randn(32, 10).astype(np.float32)
+    labels = rng.randint(0, 4, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=16,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), monitor=mon)
+    # interval=2 over 2 batches -> one collection, fc nodes only
+    assert mon.queue, "monitor collected nothing"
+    names = {name for _, name, _ in mon.queue}
+    assert any("fc1" in n for n in names), names
+    assert all("softmax" not in n for n in names), names
+    for _, _, stat in mon.queue:
+        v = float(stat.asnumpy())
+        assert np.isfinite(v) and v >= 0
+
+    # manual tic/toc surface on a bare executor
+    mon2 = mx.mon.Monitor(interval=1, sort=True)
+    exe = _mlp_symbol().simple_bind(
+        data=(4, 10), fc1_weight=(16, 10), fc1_bias=(16,),
+        fc2_weight=(4, 16), fc2_bias=(4,), softmax_label=(4,))
+    mon2.install(exe)
+    mon2.tic()
+    exe.forward()
+    res = mon2.toc()
+    assert res and [r[1] for r in res] == sorted(r[1] for r in res)
