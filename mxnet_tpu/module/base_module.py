@@ -47,6 +47,18 @@ class BaseModule:
     def init_optimizer(self, *args, **kwargs):
         raise NotImplementedError()
 
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        """Reference: BaseModule.prepare — before forward, pull the
+        row-sparse parameter rows the batch will touch from the dist
+        kvstore.  In this rebuild Module executors bind DENSE parameters
+        (sparse training is the gluon path: Embedding(sparse_grad=True)
+        + Trainer — see docs/sparse.md), so there are no row_sparse
+        module params to pull; the hook is honored for API parity and
+        ``sparse_row_id_fn`` is still invoked (its cost model — knowing
+        the touched rows — may matter to callers)."""
+        if sparse_row_id_fn is not None:
+            sparse_row_id_fn(data_batch)
+
     def install_monitor(self, mon):
         """Attach a mx.monitor.Monitor to this module's executor(s)
         (reference: BaseModule.install_monitor)."""
@@ -156,6 +168,8 @@ class BaseModule:
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
+                self.prepare(data_batch,
+                             sparse_row_id_fn=sparse_row_id_fn)
                 self.forward_backward(data_batch)
                 self.update()
                 if monitor is not None:
