@@ -40,22 +40,9 @@ GT_PAD = 2               # max gt boxes per image (synthetic)
 ROIS_PER_IMG = POST_NMS + GT_PAD   # gt boxes appended like the reference
 
 
-def make_anchors(stride, scales, ratios):
-    """Anchor grid seed, reference rcnn/rpn formula (proposal.cc
-    GenerateAnchors): base box [0,0,stride-1,stride-1] reshaped by ratio
-    then scaled."""
-    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
-    w, h = base[2] + 1, base[3] + 1
-    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
-    out = []
-    for r in ratios:
-        ws = int(round(np.sqrt(w * h / r)))
-        hs = int(round(ws * r))
-        for s in scales:
-            wss, hss = ws * s, hs * s
-            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
-                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
-    return np.asarray(out, np.float32)
+# the SAME anchor seed the Proposal op decodes with — the numpy RPN
+# targets and the op's grid must agree bit-exactly, so share the formula
+from mxnet_tpu.ops.vision_extra import _make_anchors as make_anchors
 
 
 def grid_anchors(fh, fw):

@@ -171,9 +171,12 @@ class BaseModule:
                 self.prepare(data_batch,
                              sparse_row_id_fn=sparse_row_id_fn)
                 self.forward_backward(data_batch)
-                self.update()
+                # toc BEFORE update(): the optimizer mutates arg_dict in
+                # place, and Monitor.toc re-evaluates from those arrays —
+                # stats must reflect the weights the forward actually used
                 if monitor is not None:
                     monitor.toc_print()
+                self.update()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = BatchEndParam(epoch=epoch, nbatch=nbatch,

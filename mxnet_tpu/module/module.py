@@ -233,8 +233,18 @@ class BucketingModule(BaseModule):
                 arg, aux = self._curr_module.get_params()
                 mod.init_params(arg_params=arg, aux_params=aux,
                                 allow_missing=False, force_init=True)
+            if getattr(self, "_monitor", None) is not None:
+                mod.install_monitor(self._monitor)
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
+
+    def install_monitor(self, mon):
+        """Install on every bound bucket, and on buckets bound later
+        (reference: BucketingModule.install_monitor)."""
+        self._monitor = mon
+        for mod in self._buckets.values():
+            if mod.binded:
+                mod.install_monitor(mon)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              **kwargs):

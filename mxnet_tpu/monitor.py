@@ -72,12 +72,16 @@ class Monitor:
                    for name, arr in exe.arg_dict.items()}
             env.update({name: arr._data
                         for name, arr in exe.aux_dict.items()})
+            # one shared memo per executor: each node eval reuses every
+            # ancestor already computed (one forward-equivalent pass,
+            # not O(nodes^2))
+            cache = {}
             for node in self._interior_nodes(exe):
                 try:
-                    outs = node.eval_raw(**env)
+                    out = node._eval_node(node, env, cache)
                 except Exception:
                     continue  # heads needing absent inputs (labels etc.)
-                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                outs = list(out) if isinstance(out, tuple) else [out]
                 for i, o in enumerate(outs):
                     name = node.name + (f"_output{i}" if len(outs) > 1
                                         else "_output")

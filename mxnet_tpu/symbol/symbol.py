@@ -542,13 +542,20 @@ _PARAM_SHAPE_RULES = {
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         dtype=None, init=None, stype=None, **kwargs):
-    """mx.sym.Variable (reference: symbol.var)."""
+    """mx.sym.Variable (reference: symbol.var — extra kwargs must be
+    ``__dunder__`` attrs, stored as node attrs; anything else raises,
+    matching the reference's variable())."""
+    for k in kwargs:
+        if not (k.startswith("__") and k.endswith("__")):
+            raise ValueError(
+                f"Variable attribute {k!r} must start and end with "
+                f"double underscores (reference convention: __{k}__)")
     s = Symbol(None, name, [], {})
     scope = _current_attrs()
     if scope:
         s._set_attr(**scope)
     s._set_attr(shape=shape, lr_mult=lr_mult, wd_mult=wd_mult,
-                dtype=dtype, init=init, **(attr or {}))
+                dtype=dtype, init=init, **(attr or {}), **kwargs)
     return s
 
 
