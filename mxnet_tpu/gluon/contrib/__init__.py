@@ -2,8 +2,10 @@
 
 from . import cnn
 from . import data
+from . import lora
 from . import nn
 from . import rnn
 from . import moe
+from .lora import LoRADense, apply_lora
 from .estimator import Estimator
 from .moe import MoEFFN

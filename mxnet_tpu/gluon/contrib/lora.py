@@ -1,0 +1,127 @@
+"""LoRA — low-rank adaptation for fine-tuning (Hu et al. 2021).
+
+Beyond reference scope (2018-era), but the natural fine-tuning story
+for the transformer families this zoo ships: freeze the pretrained
+weight W and learn a rank-r update ΔW = (alpha/r)·B·A, so the tuned
+layer computes y = x·(W + ΔW)ᵀ + b.  TPU-fit: the adapter path is two
+skinny MXU matmuls XLA fuses into the frozen base matmul's epilogue,
+and the optimizer state shrinks to the adapter params (the dominant
+memory cost of full fine-tuning).
+
+Two surfaces:
+- ``LoRADense``: drop-in ``nn.Dense`` wrapper owning frozen base
+  weights + trainable A/B adapters, with ``merge()`` to fold the
+  adapter into the base for deployment (exports as a plain matmul);
+- ``apply_lora(net, rank, alpha, patterns)``: walk a built network and
+  re-parameterize matching ``nn.Dense`` children in place.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..block import HybridBlock
+from .. import nn
+
+
+class LoRADense(HybridBlock):
+    """Dense with a frozen base weight and trainable low-rank update.
+
+    ``base`` (an initialized ``nn.Dense``) donates its weight/bias
+    parameters, which are frozen (``grad_req='null'``); A is init'd
+    normal, B zeros — the adapted layer starts EXACTLY equal to the
+    base layer."""
+
+    def __init__(self, base, rank=8, alpha=16.0, **kwargs):
+        super().__init__(**kwargs)
+        if not isinstance(base, nn.Dense):
+            raise TypeError(f"LoRADense wraps nn.Dense, got {type(base)}")
+        units, in_units = base.weight.shape
+        if not in_units:
+            raise ValueError(
+                "LoRADense: base Dense has deferred (unknown) in_units — "
+                "run a forward pass (or pass in_units=) before wrapping")
+        self._units = units
+        self._rank = int(rank)
+        self._scale = float(alpha) / self._rank
+        self._flatten = base._flatten
+        self.act = base.act
+        with self.name_scope():
+            # shared handles: the base params THEMSELVES (not copies),
+            # frozen, and registered under their original names so
+            # collect_params()/save_parameters still carry them
+            self.weight = base.weight
+            self.weight.grad_req = "null"
+            self.bias = base.bias
+            if self.bias is not None:
+                self.bias.grad_req = "null"
+            self.params.update(base.params)
+            self.lora_a = self.params.get(
+                "lora_a", shape=(self._rank, in_units), init="normal")
+            self.lora_b = self.params.get(
+                "lora_b", shape=(units, self._rank), init="zeros")
+
+    def hybrid_forward(self, F, x, weight, lora_a, lora_b, bias=None):
+        out = F.FullyConnected(x, weight, bias,
+                               num_hidden=self._units,
+                               no_bias=bias is None,
+                               flatten=self._flatten)
+        down = F.FullyConnected(x, lora_a, None, num_hidden=self._rank,
+                                no_bias=True, flatten=self._flatten)
+        up = F.FullyConnected(down, lora_b, None,
+                              num_hidden=self._units, no_bias=True,
+                              flatten=False)
+        out = out + self._scale * up
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def merge(self):
+        """Fold the adapter into the base weight; returns the (shared)
+        base weight NDArray — after this, exporting/serving uses one
+        plain matmul and the adapters can be dropped."""
+        from ... import ndarray as nd
+
+        w = self.weight.data()
+        delta = nd.dot(self.lora_b.data(), self.lora_a.data())
+        self.weight.set_data(w + self._scale * delta)
+        # a merged adapter contributes zero until retrained
+        self.lora_b.set_data(self.lora_b.data() * 0)
+        return self.weight.data()
+
+
+def apply_lora(net, rank=8, alpha=16.0, patterns=(".*",)):
+    """Re-parameterize matching ``nn.Dense`` children of ``net`` with
+    LoRA adapters in place; freezes every OTHER parameter too (the
+    standard LoRA fine-tuning recipe).  Returns the list of new
+    ``LoRADense`` blocks.  Call after the net is initialized and shapes
+    are resolved (one forward pass)."""
+    regs = [re.compile(p) for p in patterns]
+    wrapped = []
+
+    def visit(block):
+        for name, child in list(block._children.items()):
+            if isinstance(child, nn.Dense) and \
+                    any(r.search(child.name) for r in regs):
+                ld = LoRADense(child, rank=rank, alpha=alpha,
+                               prefix=child.prefix + "lora_")
+                ld.lora_a.initialize()
+                ld.lora_b.initialize()
+                block._children[name] = ld
+                # attribute references (e.g. self.fc1) must follow
+                for attr, val in vars(block).items():
+                    if val is child:
+                        setattr(block, attr, ld)
+                wrapped.append(ld)
+            else:
+                visit(child)
+
+    visit(net)
+    if not wrapped:
+        raise ValueError(f"apply_lora: no nn.Dense matched {patterns}")
+    lora_ids = {id(b.lora_a) for b in wrapped} \
+        | {id(b.lora_b) for b in wrapped}
+    for p in net.collect_params().values():
+        if id(p) not in lora_ids:
+            p.grad_req = "null"
+    return wrapped

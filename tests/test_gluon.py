@@ -355,3 +355,51 @@ def test_split_and_load():
     assert splits[0].shape == (2, 3)
     loaded = gluon.utils.split_and_load(np.ones((4, 2)), [mx.cpu()])
     assert loaded[0].shape == (4, 2)
+
+
+def test_lora_adapters_train_frozen_base():
+    """gluon.contrib.lora: adapted net starts equal to base (B=0),
+    only adapters train, merge() folds the update losslessly."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib import apply_lora
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(16, 12)
+                    .astype(np.float32))
+    net(x)
+    base_out = net(x).asnumpy()
+    wrapped = apply_lora(net, rank=4, alpha=8, patterns=("dense",))
+    assert len(wrapped) == 2
+    np.testing.assert_allclose(net(x).asnumpy(), base_out, rtol=1e-6)
+
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    frozen = {n: p.data().asnumpy().copy()
+              for n, p in net.collect_params().items()
+              if p.grad_req == "null"}
+    assert frozen, "base params must be frozen"
+    y = mx.nd.array(np.random.RandomState(1).randn(16, 8)
+                    .astype(np.float32))
+    l2 = gluon.loss.L2Loss()
+    first = last = None
+    for _ in range(20):
+        with autograd.record():
+            l = l2(net(x), y)
+        l.backward()
+        tr.step(16)
+        v = float(l.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < 0.7 * first, (first, last)
+    for n, p in net.collect_params().items():
+        if p.grad_req == "null":
+            np.testing.assert_array_equal(p.data().asnumpy(), frozen[n])
+    pred = net(x).asnumpy()
+    for b in wrapped:
+        b.merge()
+    np.testing.assert_allclose(net(x).asnumpy(), pred, rtol=2e-5,
+                               atol=1e-5)
