@@ -92,3 +92,8 @@ def test_example_train_rcnn():
     out = _run("train_rcnn.py", "--steps", "10", "--batch-size", "2",
                timeout=500)
     assert "rcnn training OK" in out
+
+
+def test_example_finetune_lora():
+    out = _run("finetune_lora.py", "--steps", "120")
+    assert "lora finetune OK" in out
