@@ -6,6 +6,6 @@ from . import lora
 from . import nn
 from . import rnn
 from . import moe
-from .lora import LoRADense, apply_lora
+from .lora import LoRADense, apply_lora, freeze_for_lora
 from .estimator import Estimator
 from .moe import MoEFFN

@@ -90,6 +90,29 @@ class LoRADense(HybridBlock):
         return self.weight.data()
 
 
+def freeze_for_lora(net):
+    """Freeze every parameter whose name does not contain 'lora' —
+    the fine-tuning recipe for models with BUILT-IN adapters (e.g.
+    ``gpt.GPTModel(scan_layers=True, lora_rank=r)`` /
+    ``ScanTransformerEncoder(lora_rank=r)``, whose trunk carries
+    qkv_lora_a/b stacks).  Returns (n_trainable, n_total) param
+    counts."""
+    import numpy as _np
+
+    n_train = n_total = 0
+    for name, p in net.collect_params().items():
+        n = int(_np.prod(p.shape)) if p.shape else 0
+        n_total += n
+        if "lora" in name:
+            n_train += n
+        else:
+            p.grad_req = "null"
+    if n_train == 0:
+        raise ValueError("freeze_for_lora: net has no 'lora' params — "
+                         "build it with lora_rank=... first")
+    return n_train, n_total
+
+
 def apply_lora(net, rank=8, alpha=16.0, patterns=(".*",)):
     """Re-parameterize matching ``nn.Dense`` children of ``net`` with
     LoRA adapters in place; freezes every OTHER parameter too (the

@@ -121,7 +121,7 @@ class ScanTransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, num_heads, hidden_size=None,
                  dropout=0.1, attention_impl="dense",
                  activation="gelu", remat=False, causal=False,
-                 **kwargs):
+                 lora_rank=0, lora_alpha=None, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         hidden_size = hidden_size or 4 * units
@@ -134,6 +134,10 @@ class ScanTransformerEncoder(HybridBlock):
         self._dropout = dropout
         self._attention_impl = attention_impl
         self._activation = activation
+        self._lora_rank = int(lora_rank)
+        self._lora_scale = (float(lora_alpha) / lora_rank
+                            if lora_rank else 0.0) \
+            if lora_alpha is not None else 1.0
         L, u, h = num_layers, units, hidden_size
         with self.name_scope():
             self.qkv_stack_weight = self.params.get(
@@ -164,6 +168,15 @@ class ScanTransformerEncoder(HybridBlock):
                                              init="ones")
             self.lnf_beta = self.params.get("lnf_beta", shape=(u,),
                                             init="zeros")
+            if self._lora_rank:
+                r = self._lora_rank
+                # zero-init B: the adapted trunk starts EXACTLY equal
+                # to the base; names avoid the *_stack_weight TP-rule
+                # suffixes (tiny adapters stay replicated)
+                self.qkv_lora_a = self.params.get(
+                    "qkv_lora_a", shape=(L, r, u), init="normal")
+                self.qkv_lora_b = self.params.get(
+                    "qkv_lora_b", shape=(L, 3 * u, r), init="zeros")
 
     def hybrid_forward(self, F, x, qkv_stack_weight, qkv_stack_bias,
                        proj_stack_weight, proj_stack_bias,
@@ -171,7 +184,12 @@ class ScanTransformerEncoder(HybridBlock):
                        ffn2_stack_weight, ffn2_stack_bias,
                        ln1_stack_gamma, ln1_stack_beta,
                        ln2_stack_gamma, ln2_stack_beta,
-                       lnf_gamma, lnf_beta):
+                       lnf_gamma, lnf_beta, qkv_lora_a=None,
+                       qkv_lora_b=None):
+        kw = {}
+        if qkv_lora_a is not None:
+            kw = {"qkv_lora_a": qkv_lora_a, "qkv_lora_b": qkv_lora_b,
+                  "lora_scale": self._lora_scale}
         return F.scan_transformer_encoder(
             x, qkv_stack_weight, qkv_stack_bias, proj_stack_weight,
             proj_stack_bias, ffn1_stack_weight, ffn1_stack_bias,
@@ -180,7 +198,7 @@ class ScanTransformerEncoder(HybridBlock):
             lnf_gamma, lnf_beta, num_heads=self._num_heads,
             dropout=self._dropout, activation=self._activation,
             impl=self._attention_impl, causal=self._causal,
-            remat=self._remat)
+            remat=self._remat, **kw)
 
 
 class BERTModel(HybridBlock):

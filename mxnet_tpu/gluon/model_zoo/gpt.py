@@ -28,12 +28,16 @@ class GPTModel(HybridBlock):
     def __init__(self, vocab_size=50257, units=768, num_layers=12,
                  num_heads=12, max_length=1024, hidden_size=None,
                  dropout=0.1, attention_impl="dense", scan_layers=False,
-                 remat=False, **kwargs):
+                 remat=False, lora_rank=0, lora_alpha=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._vocab = vocab_size
         self._max_length = max_length
         self._dropout = dropout
+        if lora_rank and not scan_layers:
+            raise ValueError("GPTModel: lora_rank requires "
+                             "scan_layers=True (adapters live in the "
+                             "scanned trunk)")
         with self.name_scope():
             self.tok_embed_weight = self.params.get(
                 "tok_embed_weight", shape=(vocab_size, units))
@@ -43,6 +47,7 @@ class GPTModel(HybridBlock):
                 self.encoder = ScanTransformerEncoder(
                     num_layers, units, num_heads, hidden_size, dropout,
                     attention_impl, causal=True, remat=remat,
+                    lora_rank=lora_rank, lora_alpha=lora_alpha,
                     prefix="trunk_")
             else:
                 self.encoder = TransformerEncoder(

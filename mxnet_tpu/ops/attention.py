@@ -151,9 +151,10 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
 def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
                              ffn1_w, ffn1_b, ffn2_w, ffn2_b,
                              ln1_g, ln1_b, ln2_g, ln2_b, lnf_g, lnf_b,
+                             qkv_lora_a=None, qkv_lora_b=None,
                              num_heads=1, dropout=0.0,
                              activation="gelu", impl="dense",
-                             causal=False, remat=False,
+                             causal=False, remat=False, lora_scale=1.0,
                              _is_training=True, _key=None):
     """Pre-LN transformer trunk as ONE lax.scan over stacked (L, ...)
     per-layer parameters.
@@ -164,16 +165,32 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
     body over parameter stacks compiles the layer once.  Same math as
     gluon's TransformerEncoder (packed-qkv MHA + pre-LN FFN),
     equivalence-tested in tests/test_model_zoo.py.
+
+    LoRA fine-tuning (Hu et al. 2021, beyond reference): optional
+    ``qkv_lora_a`` (L, r, U) / ``qkv_lora_b`` (L, 3U, r) stacks add a
+    rank-r update to each layer's packed qkv weight — the effective
+    weight ``qkv + lora_scale·(B@A)`` is formed per scan step (one
+    (3U,r)x(r,U) matmul, transient), so the trunk stays ONE scanned
+    layer and the adapters train through the product while the base
+    stacks stay frozen (grad_req='null').
     """
     from .nn import layer_norm
 
     use_drop = bool(dropout) and _is_training
+    use_lora = qkv_lora_a is not None and qkv_lora_b is not None
     L = qkv_w.shape[0]
 
     def body(carry, per_layer):
         (qw, qb, pw, pb, f1w, f1b, f2w, f2b, g1, b1, g2, b2) = \
             per_layer[:12]
-        key = per_layer[12] if use_drop else None
+        rest = list(per_layer[12:])
+        if use_lora:
+            la, lb = rest[0], rest[1]
+            rest = rest[2:]
+            qw = (qw + lora_scale * jnp.matmul(
+                lb, la, preferred_element_type=jnp.float32)
+                .astype(qw.dtype))
+        key = rest[0] if use_drop else None
         x = carry
         h = layer_norm(x, g1, b1)
         attn = multi_head_attention(
@@ -203,6 +220,8 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
 
     xs = (qkv_w, qkv_b, proj_w, proj_b, ffn1_w, ffn1_b, ffn2_w,
           ffn2_b, ln1_g, ln1_b, ln2_g, ln2_b)
+    if use_lora:
+        xs = xs + (qkv_lora_a, qkv_lora_b)
     if use_drop:
         xs = xs + (jax.random.split(_key, L),)
     if remat:

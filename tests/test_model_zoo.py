@@ -647,3 +647,60 @@ def test_vit_forward_and_trains():
         first = v if first is None else first
         last = v
     assert last < first, (first, last)
+
+
+def test_gpt_trunk_lora_finetuning():
+    """Built-in trunk LoRA (scan_transformer_encoder qkv adapters):
+    rank-r model with copied base params starts EXACTLY equal (B=0),
+    freeze_for_lora leaves only adapters trainable, loss drops, frozen
+    stacks don't move."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.contrib import freeze_for_lora
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    base = gpt.gpt_tiny(scan_layers=True, dropout=0.0)
+    base.initialize(init=mx.init.Xavier())
+    ids = mx.nd.array(np.random.RandomState(0)
+                      .randint(0, 100, (2, 16)).astype(np.float32))
+    ref = base(ids).asnumpy()
+
+    lnet = gpt.gpt_tiny(scan_layers=True, dropout=0.0, lora_rank=4,
+                        lora_alpha=8)
+    lnet.initialize(init=mx.init.Xavier())
+    bmap = {n.split("_", 1)[1]: p
+            for n, p in base.collect_params().items()}
+    for n, p in lnet.collect_params().items():
+        key = n.split("_", 1)[1]
+        if "lora" not in n and key in bmap:
+            p.set_data(bmap[key].data())
+    np.testing.assert_allclose(lnet(ids).asnumpy(), ref, rtol=2e-5,
+                               atol=2e-5)
+
+    n_train, n_total = freeze_for_lora(lnet)
+    assert n_train < 0.1 * n_total, (n_train, n_total)
+    tr = gluon.Trainer(lnet.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    lf = gpt.GPTLMLoss()
+    frozen = {n: p.data().asnumpy().copy()
+              for n, p in lnet.collect_params().items()
+              if p.grad_req == "null"}
+    first = last = None
+    for _ in range(8):
+        with autograd.record():
+            l = lf(lnet(ids), ids)
+        l.backward()
+        tr.step(2)
+        v = float(l.asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
+    for n, p in lnet.collect_params().items():
+        if p.grad_req == "null":
+            np.testing.assert_array_equal(p.data().asnumpy(), frozen[n])
+    # non-scan + lora must raise (adapters live in the scanned trunk)
+    with pytest.raises(ValueError):
+        gpt.GPTModel(vocab_size=100, units=32, num_layers=2,
+                     num_heads=2, scan_layers=False, lora_rank=2)
