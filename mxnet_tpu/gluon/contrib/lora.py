@@ -24,18 +24,20 @@ from ..block import HybridBlock
 from .. import nn
 
 
-class LoRADense(HybridBlock):
+class LoRADense(nn.Dense):
     """Dense with a frozen base weight and trainable low-rank update.
 
     ``base`` (an initialized ``nn.Dense``) donates its weight/bias
     parameters, which are frozen (``grad_req='null'``); A is init'd
     normal, B zeros — the adapted layer starts EXACTLY equal to the
-    base layer."""
+    base layer.  Subclasses ``nn.Dense`` so attribute rebinding and
+    isinstance contracts on the wrapped net keep holding (Dense's own
+    __init__ is bypassed: params come from ``base``)."""
 
     def __init__(self, base, rank=8, alpha=16.0, **kwargs):
-        super().__init__(**kwargs)
         if not isinstance(base, nn.Dense):
             raise TypeError(f"LoRADense wraps nn.Dense, got {type(base)}")
+        HybridBlock.__init__(self, **kwargs)  # skip Dense.__init__
         units, in_units = base.weight.shape
         if not in_units:
             raise ValueError(
@@ -46,6 +48,7 @@ class LoRADense(HybridBlock):
         self._scale = float(alpha) / self._rank
         self._flatten = base._flatten
         self.act = base.act
+        dtype = base.weight.dtype
         with self.name_scope():
             # shared handles: the base params THEMSELVES (not copies),
             # frozen, and registered under their original names so
@@ -56,10 +59,14 @@ class LoRADense(HybridBlock):
             if self.bias is not None:
                 self.bias.grad_req = "null"
             self.params.update(base.params)
+            # adapters match the base dtype: mixing would promote the
+            # layer's output dtype (breaks bf16/amp paths)
             self.lora_a = self.params.get(
-                "lora_a", shape=(self._rank, in_units), init="normal")
+                "lora_a", shape=(self._rank, in_units), init="normal",
+                dtype=dtype)
             self.lora_b = self.params.get(
-                "lora_b", shape=(units, self._rank), init="zeros")
+                "lora_b", shape=(units, self._rank), init="zeros",
+                dtype=dtype)
 
     def hybrid_forward(self, F, x, weight, lora_a, lora_b, bias=None):
         out = F.FullyConnected(x, weight, bias,
@@ -125,13 +132,18 @@ def apply_lora(net, rank=8, alpha=16.0, patterns=(".*",)):
     def visit(block):
         for name, child in list(block._children.items()):
             if isinstance(child, nn.Dense) and \
+                    not isinstance(child, LoRADense) and \
                     any(r.search(child.name) for r in regs):
                 ld = LoRADense(child, rank=rank, alpha=alpha,
                                prefix=child.prefix + "lora_")
                 ld.lora_a.initialize()
                 ld.lora_b.initialize()
-                block._children[name] = ld
-                # attribute references (e.g. self.fc1) must follow
+                # register_child (not raw dict assignment): clears the
+                # parent's cached jit/_param_order so a previously
+                # hybridized-and-run net retraces WITH the adapters
+                block.register_child(ld, name)
+                # attribute references (e.g. self.fc1) must follow;
+                # LoRADense IS-A Dense so __setattr__'s type gate holds
                 for attr, val in vars(block).items():
                     if val is child:
                         setattr(block, attr, ld)
@@ -140,6 +152,14 @@ def apply_lora(net, rank=8, alpha=16.0, patterns=(".*",)):
                 visit(child)
 
     visit(net)
+    # every ancestor holding a stale compiled forward must retrace too
+    def clear(block):
+        if hasattr(block, "_clear_cached_op"):
+            block._clear_cached_op()
+        for c in block._children.values():
+            clear(c)
+
+    clear(net)
     if not wrapped:
         raise ValueError(f"apply_lora: no nn.Dense matched {patterns}")
     lora_ids = {id(b.lora_a) for b in wrapped} \

@@ -135,9 +135,15 @@ class ScanTransformerEncoder(HybridBlock):
         self._attention_impl = attention_impl
         self._activation = activation
         self._lora_rank = int(lora_rank)
-        self._lora_scale = (float(lora_alpha) / lora_rank
-                            if lora_rank else 0.0) \
-            if lora_alpha is not None else 1.0
+        # default alpha = 2·rank → scale 2.0, matching LoRADense's
+        # default (alpha=16 at rank 8) — hyperparameters port between
+        # the two surfaces unchanged
+        if lora_rank:
+            alpha = (float(lora_alpha) if lora_alpha is not None
+                     else 2.0 * lora_rank)
+            self._lora_scale = alpha / lora_rank
+        else:
+            self._lora_scale = 0.0
         L, u, h = num_layers, units, hidden_size
         with self.name_scope():
             self.qkv_stack_weight = self.params.get(

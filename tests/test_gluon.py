@@ -403,3 +403,64 @@ def test_lora_adapters_train_frozen_base():
         b.merge()
     np.testing.assert_allclose(net(x).asnumpy(), pred, rtol=2e-5,
                                atol=1e-5)
+
+
+def test_lora_on_hybridized_attribute_held_net():
+    """Review regressions: (a) a net storing Dense as ATTRIBUTES
+    (self.fc = ...) must rebind through __setattr__'s type gate
+    (LoRADense IS-A Dense); (b) a net hybridized-AND-RUN before
+    apply_lora must retrace with the adapters (stale jit caches
+    cleared) so adapters actually train."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib import apply_lora
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc1 = nn.Dense(16, activation="relu")
+                self.fc2 = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(self.fc1(x))
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = Net()
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 6)
+                    .astype(np.float32))
+    net(x)  # builds the jit cache WITHOUT adapters
+    wrapped = apply_lora(net, rank=2, alpha=4, patterns=("dense",))
+    assert len(wrapped) == 2
+    assert net.fc1 is wrapped[0] and isinstance(net.fc1, nn.Dense)
+
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    l2 = gluon.loss.L2Loss()
+    y = mx.nd.array(np.random.RandomState(1).randn(8, 4)
+                    .astype(np.float32))
+    a0 = {i: b.lora_a.data().asnumpy().copy()
+          for i, b in enumerate(wrapped)}
+    b0 = {i: b.lora_b.data().asnumpy().copy()
+          for i, b in enumerate(wrapped)}
+    first = last = None
+    for _ in range(10):
+        with autograd.record():
+            l = l2(net(x), y)
+        l.backward()
+        tr.step(8)
+        v = float(l.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
+    # the adapters moved — the stale pre-wrap jit was NOT reused
+    moved = any(not np.allclose(b.lora_b.data().asnumpy(), b0[i])
+                for i, b in enumerate(wrapped))
+    assert moved, "adapters never trained: stale jit cache reused"
+    # idempotence: a second apply_lora must not re-wrap LoRADense
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        apply_lora(net, rank=2, patterns=("no_match_pattern",))
