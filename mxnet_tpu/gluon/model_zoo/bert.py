@@ -215,8 +215,13 @@ class BERTModel(HybridBlock):
                  num_heads=12, hidden_size=3072, max_length=512,
                  token_types=2, dropout=0.1, attention_impl="dense",
                  use_pooler=True, use_decoder=True, use_classifier=True,
-                 scan_layers=False, **kwargs):
+                 scan_layers=False, lora_rank=0, lora_alpha=None,
+                 **kwargs):
         super().__init__(**kwargs)
+        if lora_rank and not scan_layers:
+            raise ValueError("BERTModel: lora_rank requires "
+                             "scan_layers=True (adapters live in the "
+                             "scanned trunk)")
         self._units = units
         self._use_pooler = use_pooler
         self._use_decoder = use_decoder
@@ -238,7 +243,8 @@ class BERTModel(HybridBlock):
             if scan_layers:
                 self.encoder = ScanTransformerEncoder(
                     num_layers, units, num_heads, hidden_size, dropout,
-                    attention_impl, prefix="enc_")
+                    attention_impl, lora_rank=lora_rank,
+                    lora_alpha=lora_alpha, prefix="enc_")
             else:
                 self.encoder = TransformerEncoder(
                     num_layers, units, num_heads, hidden_size, dropout,

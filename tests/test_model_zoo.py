@@ -704,3 +704,21 @@ def test_gpt_trunk_lora_finetuning():
     with pytest.raises(ValueError):
         gpt.GPTModel(vocab_size=100, units=32, num_layers=2,
                      num_heads=2, scan_layers=False, lora_rank=2)
+
+
+def test_bert_trunk_lora_wires():
+    """BERT family forwards lora_rank to the scanned trunk; non-scan
+    raises; freeze leaves only adapter params trainable."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.contrib import freeze_for_lora
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    net = bert.bert_tiny(scan_layers=True, dropout=0.0, lora_rank=2)
+    net.initialize(init=mx.init.Xavier())
+    ids = mx.nd.array(np.random.RandomState(0)
+                      .randint(0, 200, (2, 16)).astype(np.float32))
+    net(ids)
+    n_train, n_total = freeze_for_lora(net)
+    assert 0 < n_train < 0.05 * n_total
+    with pytest.raises(ValueError):
+        bert.bert_tiny(lora_rank=2)  # scan_layers=False default
