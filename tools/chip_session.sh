@@ -14,7 +14,7 @@ cd "$(dirname "$0")/.." || exit 1
 OUT=chip_results.jsonl
 LOG=chip_session.log
 PROBE_EVERY=${PROBE_EVERY:-600}
-MAX_POLLS=${MAX_POLLS:-40}
+MAX_POLLS=${MAX_POLLS:-60}
 
 log() { echo "[$(date +%T)] $*" >> "$LOG"; }
 
@@ -59,6 +59,9 @@ for i in $(seq 1 "$MAX_POLLS"); do
         run_step resnet50_b256_nhwc 2700 python bench.py --worker \
             '{"model": "resnet50", "batch": 256, "image": 224, "steps": 20, "backend": "tpu", "layout": "NHWC"}'
         run_step full_bench 2400 python bench.py
+        # cheap extras once the cache is warm: on-chip decode + sparse
+        run_step bench_decode 1200 python tools/bench_decode.py
+        run_step bench_sparse 1200 python tools/bench_sparse.py
         log "sequence complete"
         exit 0
     fi
