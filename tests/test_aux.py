@@ -340,3 +340,32 @@ def test_contrib_data_sampler_and_text():
                                    sampler=IntervalSampler(len(ds), 2))
     xb, yb = next(iter(loader))
     assert xb.shape == (4, 16)
+
+
+def test_parse_log_tool():
+    """tools/parse_log.py parses the fit/Speedometer log formats into
+    an epoch table (reference: tools/parse_log.py)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log = ("INFO Epoch[0] Batch [10-20]\tSpeed: 1000.00 samples/sec\n"
+           "INFO Epoch[0] Train-accuracy=0.600000\n"
+           "INFO Epoch[0] Time cost=12.300\n"
+           "INFO Epoch[1] Train-accuracy=0.800000\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".log",
+                                     delete=False) as f:
+        f.write(log)
+        path = f.name
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         path, "--format", "csv"],
+        capture_output=True, text=True, timeout=60)
+    os.unlink(path)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "epoch,speed,time,train-accuracy"
+    assert lines[1].startswith("0,1000.0,12.3,0.6")
+    assert lines[2].startswith("1,,")

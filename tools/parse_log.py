@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Parse training logs into an epoch table (reference: tools/parse_log.py
+— turns Module.fit / Speedometer logging into markdown/csv rows).
+
+Input lines it understands (the formats this framework's fit loop and
+Speedometer emit, same shapes as the reference):
+
+    Epoch[3] Train-accuracy=0.912
+    Epoch[3] Validation-accuracy=0.874
+    Epoch[3] Time cost=123.456
+    Epoch[3] Batch [40]  Speed: 1234.56 samples/sec  accuracy=0.91
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.eE+-]+)")
+SPEED = re.compile(
+    r"Epoch\[(\d+)\].*Speed:\s*([0-9.eE+-]+)\s*samples/sec")
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for ln in lines:
+        m = EPOCH_METRIC.search(ln)
+        if m:
+            ep, kind, name, val = m.groups()
+            rows[int(ep)][f"{kind.lower()}-{name}"] = float(val)
+            continue
+        m = EPOCH_TIME.search(ln)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+            continue
+        m = SPEED.search(ln)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+    for ep, ss in speeds.items():
+        rows[ep]["speed"] = sum(ss) / len(ss)
+    return dict(rows)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("markdown", "csv"),
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        sys.exit("no epoch lines recognized")
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "csv":
+        print(",".join(["epoch"] + cols))
+        for ep in sorted(rows):
+            print(",".join([str(ep)] + [str(rows[ep].get(c, ""))
+                                        for c in cols]))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for ep in sorted(rows):
+            print(f"| {ep} | " + " | ".join(
+                f"{rows[ep][c]:.4g}" if c in rows[ep] else ""
+                for c in cols) + " |")
+
+
+if __name__ == "__main__":
+    main()
