@@ -97,3 +97,10 @@ def test_example_train_rcnn():
 def test_example_finetune_lora():
     out = _run("finetune_lora.py", "--steps", "120")
     assert "lora finetune OK" in out
+
+
+def test_example_pipeline_parallel_bert():
+    out = _run("pipeline_parallel_bert.py", "--steps", "5", "--pp", "4",
+               "--batch-size", "8", timeout=500)
+    assert "pipeline pretrain OK" in out
+    assert "bubble=" in out
