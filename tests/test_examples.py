@@ -104,3 +104,9 @@ def test_example_pipeline_parallel_bert():
                "--batch-size", "8", timeout=500)
     assert "pipeline pretrain OK" in out
     assert "bubble=" in out
+
+
+def test_example_dcgan():
+    out = _run("dcgan.py", "--steps", "50", "--batch-size", "16",
+               timeout=500)
+    assert "dcgan OK" in out
