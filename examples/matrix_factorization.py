@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender (reference: example/recommenders/
++ example/sparse/matrix_factorization — the embedding-heavy tier).
+
+Rating(u, i) ≈ <U_u, V_i> + b_u + c_i on synthetic low-rank ratings.
+The embeddings use ``sparse_grad=True``: each step's gradient is a
+compact row_sparse NDArray over the rows the batch touched (the
+round-4 sparse path — 245× smaller than dense at 1M rows), and the
+optimizer updates exactly those rows."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class MFBlock(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, dim, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.user_embed = nn.Embedding(n_users, dim,
+                                           sparse_grad=True)
+            self.item_embed = nn.Embedding(n_items, dim,
+                                           sparse_grad=True)
+            self.user_bias = nn.Embedding(n_users, 1, sparse_grad=True)
+            self.item_bias = nn.Embedding(n_items, 1, sparse_grad=True)
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user_embed(users) * self.item_embed(items)
+        return (F.sum(p, axis=-1) + self.user_bias(users).squeeze(-1)
+                + self.item_bias(items).squeeze(-1))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--users", type=int, default=400)
+    parser.add_argument("--items", type=int, default=300)
+    parser.add_argument("--dim", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch-size", type=int, default=256)
+    args = parser.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    # ground-truth low-rank ratings
+    U = rng.randn(args.users, args.dim).astype(np.float32) * 0.5
+    V = rng.randn(args.items, args.dim).astype(np.float32) * 0.5
+    net = MFBlock(args.users, args.items, args.dim)
+    net.initialize(init=mx.init.Normal(0.05))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    l2 = gluon.loss.L2Loss()
+
+    first = last = None
+    checked_sparse = False
+    for step in range(args.steps):
+        u = rng.randint(0, args.users, args.batch_size)
+        i = rng.randint(0, args.items, args.batch_size)
+        r = (U[u] * V[i]).sum(1) + rng.normal(0, 0.05, args.batch_size) \
+            .astype(np.float32)
+        with autograd.record():
+            loss = l2(net(mx.nd.array(u), mx.nd.array(i)), mx.nd.array(r))
+        loss.backward()
+        if not checked_sparse:
+            g = net.user_embed.weight.grad()
+            stype = getattr(g, "stype", "default")
+            n_rows = g.indices.shape[0] if stype == "row_sparse" else -1
+            print(f"user-embed grad stype={stype}, "
+                  f"{n_rows}/{args.users} rows touched")
+            assert stype == "row_sparse"
+            checked_sparse = True
+        trainer.step(args.batch_size)
+        v = float(loss.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+        if step % 50 == 0:
+            print(f"step {step}: loss {v:.4f}")
+
+    rmse = np.sqrt(2 * last)  # L2Loss is 0.5*(p-r)^2
+    print(f"loss first {first:.4f} -> last {last:.4f} (RMSE {rmse:.3f})")
+    print("matrix factorization OK" if last < 0.25 * first
+          else "matrix factorization did not converge")
+    if last >= 0.25 * first:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

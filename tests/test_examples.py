@@ -110,3 +110,9 @@ def test_example_dcgan():
     out = _run("dcgan.py", "--steps", "50", "--batch-size", "16",
                timeout=500)
     assert "dcgan OK" in out
+
+
+def test_example_matrix_factorization():
+    out = _run("matrix_factorization.py", "--steps", "150", timeout=500)
+    assert "matrix factorization OK" in out
+    assert "stype=row_sparse" in out
