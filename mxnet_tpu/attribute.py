@@ -27,6 +27,15 @@ class AttrScope:
     inner scopes override outer keys)."""
 
     def __init__(self, **kwargs):
+        # reference contract: attribute values must be strings (they
+        # serialize into symbol.json verbatim; non-strings would change
+        # type across a save/load round trip)
+        for k, v in kwargs.items():
+            if v is not None and not isinstance(v, str):
+                raise ValueError(
+                    f"AttrScope: attribute {k}={v!r} must be a string "
+                    f"(got {type(v).__name__}) — reference "
+                    f"attribute.py enforces the same")
         self._attr = {k: v for k, v in kwargs.items() if v is not None}
 
     def __enter__(self):

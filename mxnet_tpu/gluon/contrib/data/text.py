@@ -29,6 +29,14 @@ class CharTokenDataset(Dataset):
         if isinstance(source, str) and os.path.exists(source):
             with open(source, encoding="utf-8") as f:
                 text = f.read()
+        elif isinstance(source, str) and (os.sep in source
+                                          or source.endswith(".txt")):
+            # looks like a path but doesn't exist: fail loudly (the
+            # reference datasets do) instead of training on the path
+            # string as a corpus
+            raise FileNotFoundError(
+                f"CharTokenDataset: no such file {source!r} (to pass "
+                f"literal text containing '/', read the file yourself)")
         else:
             text = source
         if vocab is None:

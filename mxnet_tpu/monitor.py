@@ -6,9 +6,13 @@ Reference mechanism: a callback hooked into every op execution
 ``toc()``.  Under XLA the whole graph is ONE compiled program with no
 per-op callbacks, so the TPU-native Monitor evaluates the matching
 interior nodes eagerly from the executor's current arguments at
-``toc()`` time — same statistics (arguments don't change between the
-monitored forward and toc), debugging-priced (extra eager evaluation;
-install only while diagnosing, exactly like the reference's advice).
+``toc()`` time — same mode, same arguments, debugging-priced (extra
+eager evaluation; install only while diagnosing, exactly like the
+reference's advice).  One caveat vs the reference's passive callback:
+stochastic ops (Dropout) re-sample under a monitor-local PRNG key, so
+their statistics are representative, not the exact masks of the
+monitored forward — the global key stream is left untouched (the
+observer never changes the experiment).
 """
 
 from __future__ import annotations
@@ -45,9 +49,12 @@ class Monitor:
 
     def install(self, exe):
         """Register an executor to monitor (reference: install on every
-        executor in the group)."""
-        if exe not in self._exes:
-            self._exes.append(exe)
+        executor in the group).  A new executor for the SAME symbol
+        (rebind) evicts the stale one — toc() must not keep reporting
+        from dead pre-rebind arg arrays."""
+        self._exes = [e for e in self._exes
+                      if e is not exe and e._symbol is not exe._symbol]
+        self._exes.append(exe)
 
     def tic(self):
         """Start collecting for this batch if the interval hits."""
@@ -66,19 +73,33 @@ class Monitor:
         (step, node_name, stat) with stat an NDArray scalar."""
         if not self.activated:
             return []
+        import jax
+
+        from . import autograd as _ag
+        from . import random as _random
+
         res = []
         for exe in self._exes:
             env = {name: arr._data
                    for name, arr in exe.arg_dict.items()}
             env.update({name: arr._data
                         for name, arr in exe.aux_dict.items()})
+            # re-evaluate in the SAME mode the monitored forward ran in
+            # (dropout/BN stats must match the training step), and under
+            # a LOCAL key scope so the eval does not advance the global
+            # PRNG stream — the observer must not change the experiment
+            mode = _ag.train_mode \
+                if getattr(exe, "_last_is_train", False) \
+                else _ag.predict_mode
             # one shared memo per executor: each node eval reuses every
             # ancestor already computed (one forward-equivalent pass,
             # not O(nodes^2))
             cache = {}
             for node in self._interior_nodes(exe):
                 try:
-                    out = node._eval_node(node, env, cache)
+                    with mode(), _random.key_scope(
+                            jax.random.PRNGKey(self.step)):
+                        out = node._eval_node(node, env, cache)
                 except Exception:
                     continue  # heads needing absent inputs (labels etc.)
                 outs = list(out) if isinstance(out, tuple) else [out]

@@ -83,6 +83,7 @@ class Executor:
         if self._fwd_jit is None:
             self._build()
         vals = [self.arg_dict[n]._data for n in self._arg_names]
+        self._last_is_train = bool(is_train)  # Monitor re-evals in-mode
         with (_ag.train_mode() if is_train else _ag.predict_mode()):
             out = self._fwd_jit(vals)
         outs = out if isinstance(out, (tuple, list)) else [out]

@@ -429,3 +429,45 @@ def test_monitor_collects_layer_stats():
     exe.forward()
     res = mon2.toc()
     assert res and [r[1] for r in res] == sorted(r[1] for r in res)
+
+
+def test_monitor_mode_and_prng_isolation():
+    """Review regressions: toc() re-evaluates in the mode the monitored
+    forward used (train-mode dropout ACTIVE in stats), and must not
+    advance the global PRNG stream (observer effect)."""
+    import mxnet_tpu.random as mxrand
+
+    x = mx.sym.var("data")
+    d = mx.sym.Dropout(x, p=0.5, name="drop")
+    out = mx.sym.MakeLoss(mx.sym.mean(d * d), name="loss")
+    exe = out.simple_bind(data=(64, 64))
+    exe.arg_dict["data"]._set_data(mx.nd.ones((64, 64))._data)
+    mon = mx.monitor.Monitor(interval=1, pattern=".*drop.*")
+    mon.install(exe)
+
+    mon.tic()
+    exe.forward(is_train=True)
+    key_before = mxrand._STATE.key
+    res = mon.toc()
+    assert mxrand._STATE.key is key_before, \
+        "toc() advanced the global PRNG stream"
+    # train-mode dropout: mean |out| of kept/scaled ones is ~1, and the
+    # zeros prove dropout actually ran (predict mode would give exactly 1)
+    stats = {name: float(s.asnumpy()) for _, name, s in res}
+    drop_stat = next(v for k, v in stats.items() if "drop" in k)
+    assert 0.7 < drop_stat < 1.3, stats
+    # re-eval the same node eagerly in predict mode: identity => 1.0
+    mon2 = mx.monitor.Monitor(interval=1, pattern=".*drop.*")
+    mon2.install(exe)
+    mon2.tic()
+    exe.forward(is_train=False)
+    stats2 = {name: float(s.asnumpy())
+              for _, name, s in mon2.toc()}
+    drop2 = next(v for k, v in stats2.items() if "drop" in k)
+    assert abs(drop2 - 1.0) < 1e-6, stats2
+
+    # rebind eviction: a new executor over the same symbol replaces the
+    # stale one
+    exe2 = out.simple_bind(data=(8, 8))
+    mon.install(exe2)
+    assert len(mon._exes) == 1 and mon._exes[0] is exe2
