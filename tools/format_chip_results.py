@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Format chip_results.jsonl (tools/chip_session.sh output) into the
+BASELINE.md measurement table."""
+
+import json
+import sys
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "chip_results.jsonl"
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    if not rows:
+        sys.exit("no results")
+    print("| step | rc | secs | metric | value | mfu | detail |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        res = r.get("result") or {}
+        detail = ", ".join(
+            f"{k}={res[k]}" for k in ("batch", "seq", "image", "layout",
+                                      "attn", "calib_tflops",
+                                      "device_kind")
+            if k in res and res[k] is not None)
+        print(f"| {r['step']} | {r['rc']} | {r['secs']} "
+              f"| {res.get('metric', '—')} | {res.get('value', '—')} "
+              f"| {res.get('mfu', '—')} | {detail} |")
+
+
+if __name__ == "__main__":
+    main()
