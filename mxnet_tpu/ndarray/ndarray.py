@@ -193,6 +193,7 @@ class NDArray:
         self._version += 1
 
     def __setitem__(self, key, value):
+        import jax
         import jax.numpy as jnp
 
         key = _unwrap_index(key)
@@ -200,11 +201,22 @@ class NDArray:
             value = value._data
         if isinstance(key, tuple) and len(key) == 0:
             key = Ellipsis
-        self._set_data(self._data.at[key].set(value))
+        if _index_needs_x64(key):
+            # int64 index path (reference INT64_TENSOR_SIZE / nightly
+            # large-array tier): jax's x32 default can't carry indices
+            # past 2^31 into the scatter
+            with jax.enable_x64(True):
+                self._set_data(self._data.at[key].set(value))
+        else:
+            self._set_data(self._data.at[key].set(value))
 
     def __getitem__(self, key):
-        nd_keys = []
+        import jax
+
         key2 = _unwrap_index(key)
+        if _index_needs_x64(key2):
+            with jax.enable_x64(True):
+                return _from_jax(self._data[key2])
         return self._apply(lambda d: d[key2], name="getitem")
 
     # -- python protocol -------------------------------------------------------
@@ -394,6 +406,26 @@ class NDArray:
         from .register import invoke_registered
 
         return invoke_registered("reshape_like", (self, other), {})
+
+
+_INT32_MAX = 2 ** 31 - 1
+
+
+def _index_needs_x64(key):
+    """True when any integer index / slice bound exceeds int32 range —
+    the large-tensor (INT64_TENSOR_SIZE) indexing path."""
+    def big(v):
+        return isinstance(v, int) and not isinstance(v, bool) \
+            and abs(v) > _INT32_MAX
+
+    items = key if isinstance(key, tuple) else (key,)
+    for it in items:
+        if big(it):
+            return True
+        if isinstance(it, slice) and (
+                big(it.start) or big(it.stop) or big(it.step)):
+            return True
+    return False
 
 
 def _unwrap_index(key):

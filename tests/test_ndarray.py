@@ -1,5 +1,7 @@
 """NDArray core tests (reference: tests/python/unittest/test_ndarray.py)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -248,3 +250,18 @@ def test_linalg():
     assert np.allclose(L.asnumpy() @ L.asnumpy().T, spd, atol=1e-4)
     g = nd.linalg.gemm2(nd.array(a), nd.array(a), transpose_b=True)
     assert np.allclose(g.asnumpy(), a @ a.T, atol=1e-5)
+
+
+@pytest.mark.skipif(not os.environ.get("MXNET_TEST_LARGE"),
+                    reason="nightly tier (reference: tests/nightly/"
+                           "test_large_array.py) — set MXNET_TEST_LARGE=1; "
+                           "allocates >2 GB")
+def test_large_array_int64_indexing():
+    """INT64_TENSOR_SIZE: element counts past 2^31 index correctly
+    (reference nightly large-array tier)."""
+    n = 2_200_000_000  # > 2^31
+    a = mx.nd.zeros((n,), dtype="int8")
+    a[n - 1] = 7
+    assert int(a[n - 1].asnumpy()) == 7
+    assert int(a.sum().asnumpy()) == 7
+    assert a.shape == (n,)
