@@ -35,7 +35,15 @@ def array(source_array, ctx=None, dtype=None):
         # reference semantics: default dtype is float32 for any non-NDArray
         # source (python/mxnet/ndarray/ndarray.py `array`)
         np_arr = np_arr.astype(_np.float32)
-    arr = jax.device_put(jnp.asarray(np_arr), dev)
+    if dtype is not None and _np.dtype(dtype).itemsize == 8 \
+            and dtype != "bfloat16":
+        # explicitly-requested 64-bit dtype: jax's x32 default would
+        # silently truncate (int64 values past 2^31 WRAP) — create
+        # under x64 so the storage honors the request
+        with jax.enable_x64(True):
+            arr = jax.device_put(jnp.asarray(np_arr), dev)
+    else:
+        arr = jax.device_put(jnp.asarray(np_arr), dev)
     if dtype == "bfloat16":
         arr = arr.astype(jnp.bfloat16)
     return NDArray(arr, ctx)

@@ -201,10 +201,11 @@ class NDArray:
             value = value._data
         if isinstance(key, tuple) and len(key) == 0:
             key = Ellipsis
-        if _index_needs_x64(key):
+        if _index_needs_x64(key, self._data.shape):
             # int64 index path (reference INT64_TENSOR_SIZE / nightly
-            # large-array tier): jax's x32 default can't carry indices
-            # past 2^31 into the scatter
+            # large-array tier): under jax's x32 default a scatter on a
+            # >2^31 dim silently DROPS updates (and an index past 2^31
+            # can't be carried at all)
             with jax.enable_x64(True):
                 self._set_data(self._data.at[key].set(value))
         else:
@@ -214,7 +215,7 @@ class NDArray:
         import jax
 
         key2 = _unwrap_index(key)
-        if _index_needs_x64(key2):
+        if _index_needs_x64(key2, self._data.shape):
             with jax.enable_x64(True):
                 return _from_jax(self._data[key2])
         return self._apply(lambda d: d[key2], name="getitem")
@@ -411,9 +412,14 @@ class NDArray:
 _INT32_MAX = 2 ** 31 - 1
 
 
-def _index_needs_x64(key):
-    """True when any integer index / slice bound exceeds int32 range —
-    the large-tensor (INT64_TENSOR_SIZE) indexing path."""
+def _index_needs_x64(key, shape=()):
+    """True when indexing must run under x64 — any integer index /
+    slice bound past int32 range, or ANY dim of the indexed array past
+    2^31 (x32 gather/scatter on such arrays silently truncates or
+    drops; the INT64_TENSOR_SIZE large-tensor path)."""
+    if shape and max(shape) > _INT32_MAX:
+        return True
+
     def big(v):
         return isinstance(v, int) and not isinstance(v, bool) \
             and abs(v) > _INT32_MAX

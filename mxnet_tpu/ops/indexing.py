@@ -15,6 +15,14 @@ from ..base import np_dtype
 @register("take")
 def take(a, indices, axis=0, mode="clip"):
     m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    if a.shape[axis] > 2 ** 31 - 1:
+        # large-tensor gather (INT64_TENSOR_SIZE): int32 index carry
+        # would silently truncate — run the gather under x64
+        import jax
+
+        with jax.enable_x64(True):
+            return jnp.take(a, indices.astype(jnp.int64), axis=axis,
+                            mode=m)
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)
 
 

@@ -258,10 +258,17 @@ def test_linalg():
                            "allocates >2 GB")
 def test_large_array_int64_indexing():
     """INT64_TENSOR_SIZE: element counts past 2^31 index correctly
-    (reference nightly large-array tier)."""
+    (reference nightly large-array tier).  Covers the three x32
+    failure modes found building this: index-carry overflow, silent
+    scatter drop on >2^31 dims, and int64-creation truncation."""
     n = 2_200_000_000  # > 2^31
     a = mx.nd.zeros((n,), dtype="int8")
     a[n - 1] = 7
+    a[5] = 2  # small index on a HUGE dim: x32 scatter silently drops
     assert int(a[n - 1].asnumpy()) == 7
-    assert int(a.sum().asnumpy()) == 7
+    assert int(a[5].asnumpy()) == 2
+    assert int(a.sum().asnumpy()) == 9
     assert a.shape == (n,)
+    idx = mx.nd.array(np.array([5, n - 1], np.int64), dtype="int64")
+    assert idx.dtype == np.int64  # creation must honor int64
+    assert list(mx.nd.take(a, idx).asnumpy()) == [2, 7]
