@@ -272,3 +272,22 @@ def test_large_array_int64_indexing():
     idx = mx.nd.array(np.array([5, n - 1], np.int64), dtype="int64")
     assert idx.dtype == np.int64  # creation must honor int64
     assert list(mx.nd.take(a, idx).asnumpy()) == [2, 7]
+
+
+def test_explicit_64bit_dtypes_roundtrip(tmp_path):
+    """Explicit int64/float64 NDArrays must hold and save/load values
+    past 32-bit range (jax's x32 default silently wrapped both — the
+    creation and load paths route through x64)."""
+    i64 = mx.nd.array(np.array([5, 2_199_999_999], np.int64),
+                      dtype="int64")
+    f64 = mx.nd.array(np.array([1.5, 1e300]), dtype="float64")
+    assert i64.dtype == np.int64 and f64.dtype == np.float64
+    assert int(i64.asnumpy()[1]) == 2_199_999_999
+    assert np.isfinite(f64.asnumpy()[1])
+    f = str(tmp_path / "big.params")
+    mx.nd.save(f, {"i": i64, "f": f64})
+    back = mx.nd.load(f)
+    assert back["i"].dtype == np.int64
+    np.testing.assert_array_equal(back["i"].asnumpy(), i64.asnumpy())
+    assert back["f"].dtype == np.float64
+    np.testing.assert_array_equal(back["f"].asnumpy(), f64.asnumpy())
