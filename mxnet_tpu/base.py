@@ -87,6 +87,25 @@ def np_dtype(dtype):
     return dtype
 
 
+def x64_scope_if(dtype):
+    """Context manager enabling jax x64 when `dtype` is a 64-bit type —
+    the x32 default otherwise silently truncates int64/float64 values
+    (INT64_TENSOR_SIZE honesty; see tests/test_ndarray.py round-trips)."""
+    import contextlib
+
+    try:
+        wide = dtype is not None and dtype != "bfloat16" \
+            and _np.dtype(dtype).itemsize == 8 \
+            and _np.dtype(dtype).kind in "iuf"
+    except TypeError:
+        wide = False
+    if wide:
+        import jax
+
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
+
+
 def getenv_int(name: str, default: int) -> int:
     """Env config plane (reference: dmlc::GetEnv, docs/faq/env_var.md)."""
     try:

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-from ..base import MXNetError, np_dtype
+from ..base import MXNetError, np_dtype, x64_scope_if
 from ..context import Context, current_context
 from .ndarray import NDArray, _from_jax
 from . import register as _register
@@ -35,14 +35,9 @@ def array(source_array, ctx=None, dtype=None):
         # reference semantics: default dtype is float32 for any non-NDArray
         # source (python/mxnet/ndarray/ndarray.py `array`)
         np_arr = np_arr.astype(_np.float32)
-    if dtype is not None and _np.dtype(dtype).itemsize == 8 \
-            and dtype != "bfloat16":
-        # explicitly-requested 64-bit dtype: jax's x32 default would
-        # silently truncate (int64 values past 2^31 WRAP) — create
-        # under x64 so the storage honors the request
-        with jax.enable_x64(True):
-            arr = jax.device_put(jnp.asarray(np_arr), dev)
-    else:
+    # explicitly-requested 64-bit dtypes create under x64: jax's x32
+    # default would silently truncate (int64 values past 2^31 WRAP)
+    with x64_scope_if(dtype):
         arr = jax.device_put(jnp.asarray(np_arr), dev)
     if dtype == "bfloat16":
         arr = arr.astype(jnp.bfloat16)
@@ -55,8 +50,9 @@ def zeros(shape, ctx=None, dtype=None, **kwargs):
 
     dev, ctx = _device(ctx)
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.zeros(shape, np_dtype(dtype)), dev),
-                   ctx)
+    with x64_scope_if(dtype):
+        return NDArray(
+            jax.device_put(jnp.zeros(shape, np_dtype(dtype)), dev), ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
@@ -65,8 +61,9 @@ def ones(shape, ctx=None, dtype=None, **kwargs):
 
     dev, ctx = _device(ctx)
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.ones(shape, np_dtype(dtype)), dev),
-                   ctx)
+    with x64_scope_if(dtype):
+        return NDArray(
+            jax.device_put(jnp.ones(shape, np_dtype(dtype)), dev), ctx)
 
 
 def full(shape, val, ctx=None, dtype=None):
@@ -75,8 +72,9 @@ def full(shape, val, ctx=None, dtype=None):
 
     dev, ctx = _device(ctx)
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.full(shape, val, np_dtype(dtype)),
-                                  dev), ctx)
+    with x64_scope_if(dtype):
+        return NDArray(jax.device_put(
+            jnp.full(shape, val, np_dtype(dtype)), dev), ctx)
 
 
 def empty(shape, ctx=None, dtype=None):
@@ -88,10 +86,11 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
     import jax.numpy as jnp
 
     dev, ctx = _device(ctx)
-    out = jnp.arange(start, stop, step, np_dtype(dtype or "float32"))
-    if repeat > 1:
-        out = jnp.repeat(out, repeat)
-    return NDArray(jax.device_put(out, dev), ctx)
+    with x64_scope_if(dtype):
+        out = jnp.arange(start, stop, step, np_dtype(dtype or "float32"))
+        if repeat > 1:
+            out = jnp.repeat(out, repeat)
+        return NDArray(jax.device_put(out, dev), ctx)
 
 
 def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):

@@ -153,7 +153,10 @@ class NDArray:
         return NDArray(self._data, self._ctx)
 
     def astype(self, dtype, copy=True):
-        return self._apply(lambda d: d.astype(np_dtype(dtype)))
+        from ..base import x64_scope_if
+
+        with x64_scope_if(dtype):
+            return self._apply(lambda d: d.astype(np_dtype(dtype)))
 
     # -- autograd --------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):

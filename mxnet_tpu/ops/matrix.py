@@ -231,9 +231,10 @@ def where(condition, x, y):
 
 @register("cast", aliases=("Cast",))
 def cast(data, dtype="float32"):
-    from ..base import np_dtype
+    from ..base import np_dtype, x64_scope_if
 
-    return data.astype(np_dtype(dtype))
+    with x64_scope_if(dtype):
+        return data.astype(np_dtype(dtype))
 
 
 @register("amp_cast")

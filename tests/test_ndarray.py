@@ -291,3 +291,18 @@ def test_explicit_64bit_dtypes_roundtrip(tmp_path):
     np.testing.assert_array_equal(back["i"].asnumpy(), i64.asnumpy())
     assert back["f"].dtype == np.float64
     np.testing.assert_array_equal(back["f"].asnumpy(), f64.asnumpy())
+
+
+def test_64bit_creators_and_casts():
+    """zeros/ones/full/arange/astype/cast honor 64-bit dtypes with
+    values past 32-bit range (each routed through x64_scope_if)."""
+    assert nd.zeros((3,), dtype="int64").dtype == np.int64
+    assert nd.ones((2,), dtype="float64").dtype == np.float64
+    assert int(nd.full((2,), 2_199_999_999,
+                       dtype="int64").asnumpy()[0]) == 2_199_999_999
+    ar = nd.arange(2_199_999_998, 2_200_000_001, 1, dtype="int64")
+    assert ar.dtype == np.int64
+    assert int(ar.asnumpy()[-1]) == 2_200_000_000
+    a = nd.array(np.array([2.2e9]), dtype="float64")
+    assert int(a.astype("int64").asnumpy()[0]) == 2_200_000_000
+    assert int(nd.cast(a, dtype="int64").asnumpy()[0]) == 2_200_000_000
