@@ -87,23 +87,32 @@ def np_dtype(dtype):
     return dtype
 
 
-def x64_scope_if(dtype):
-    """Context manager enabling jax x64 when `dtype` is a 64-bit type —
-    the x32 default otherwise silently truncates int64/float64 values
-    (INT64_TENSOR_SIZE honesty; see tests/test_ndarray.py round-trips)."""
+def x64_scope(cond):
+    """Context manager enabling jax x64 when `cond` — the x32 default
+    otherwise silently truncates int64/float64 values and drops scatter
+    updates on >2^31 dims (INT64_TENSOR_SIZE honesty; see
+    tests/test_ndarray.py round-trips)."""
     import contextlib
 
-    try:
-        wide = dtype is not None and dtype != "bfloat16" \
-            and _np.dtype(dtype).itemsize == 8 \
-            and _np.dtype(dtype).kind in "iuf"
-    except TypeError:
-        wide = False
-    if wide:
+    if cond:
         import jax
 
         return jax.enable_x64(True)
     return contextlib.nullcontext()
+
+
+def is_64bit_dtype(dtype):
+    try:
+        return dtype is not None and dtype != "bfloat16" \
+            and _np.dtype(dtype).itemsize == 8 \
+            and _np.dtype(dtype).kind in "iuf"
+    except TypeError:
+        return False
+
+
+def x64_scope_if(dtype):
+    """x64_scope keyed on a dtype being 64-bit."""
+    return x64_scope(is_64bit_dtype(dtype))
 
 
 def getenv_int(name: str, default: int) -> int:

@@ -98,9 +98,10 @@ def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
     import jax.numpy as jnp
 
     dev, ctx = _device(ctx)
-    return NDArray(jax.device_put(
-        jnp.linspace(start, stop, num, endpoint=endpoint,
-                     dtype=np_dtype(dtype or "float32")), dev), ctx)
+    with x64_scope_if(dtype):
+        return NDArray(jax.device_put(
+            jnp.linspace(start, stop, num, endpoint=endpoint,
+                         dtype=np_dtype(dtype or "float32")), dev), ctx)
 
 
 def eye(N, M=0, k=0, ctx=None, dtype=None):
@@ -108,8 +109,9 @@ def eye(N, M=0, k=0, ctx=None, dtype=None):
     import jax.numpy as jnp
 
     dev, ctx = _device(ctx)
-    return NDArray(jax.device_put(
-        jnp.eye(N, M or None, k, np_dtype(dtype)), dev), ctx)
+    with x64_scope_if(dtype):
+        return NDArray(jax.device_put(
+            jnp.eye(N, M or None, k, np_dtype(dtype)), dev), ctx)
 
 
 def from_numpy(a, zero_copy=False):

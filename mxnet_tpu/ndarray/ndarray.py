@@ -196,32 +196,30 @@ class NDArray:
         self._version += 1
 
     def __setitem__(self, key, value):
-        import jax
-        import jax.numpy as jnp
+        from ..base import is_64bit_dtype, x64_scope
 
         key = _unwrap_index(key)
         if isinstance(value, NDArray):
             value = value._data
         if isinstance(key, tuple) and len(key) == 0:
             key = Ellipsis
-        if _index_needs_x64(key, self._data.shape):
-            # int64 index path (reference INT64_TENSOR_SIZE / nightly
-            # large-array tier): under jax's x32 default a scatter on a
-            # >2^31 dim silently DROPS updates (and an index past 2^31
-            # can't be carried at all)
-            with jax.enable_x64(True):
-                self._set_data(self._data.at[key].set(value))
-        else:
+        # x64 when the index space, the array's own dtype, or the
+        # assigned scalar needs 64 bits: under x32 a scatter on a >2^31
+        # dim silently DROPS updates, an index past 2^31 can't be
+        # carried, and an int64 value wraps through canonicalization
+        big_val = isinstance(value, int) and abs(value) > _INT32_MAX
+        with x64_scope(_index_needs_x64(key, self._data.shape)
+                       or is_64bit_dtype(self._data.dtype) or big_val):
             self._set_data(self._data.at[key].set(value))
 
     def __getitem__(self, key):
-        import jax
+        from ..base import x64_scope
 
         key2 = _unwrap_index(key)
-        if _index_needs_x64(key2, self._data.shape):
-            with jax.enable_x64(True):
-                return _from_jax(self._data[key2])
-        return self._apply(lambda d: d[key2], name="getitem")
+        # the x64 case still routes through _apply so tape recording,
+        # engine sync, and context propagation are identical
+        with x64_scope(_index_needs_x64(key2, self._data.shape)):
+            return self._apply(lambda d: d[key2], name="getitem")
 
     # -- python protocol -------------------------------------------------------
     def __len__(self):
@@ -456,4 +454,7 @@ def _from_jax(arr, ctx=None) -> NDArray:
 def _unpickle_ndarray(np_data, stype):
     import jax.numpy as jnp
 
-    return NDArray(jnp.asarray(np_data), stype=stype)
+    from ..base import x64_scope_if
+
+    with x64_scope_if(np_data.dtype):
+        return NDArray(jnp.asarray(np_data), stype=stype)

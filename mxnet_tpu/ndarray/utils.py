@@ -74,14 +74,12 @@ def _read_flag_values(f, flag, n_elems, shape):
         return jnp.asarray(raw).view(jnp.bfloat16).reshape(shape)
     dt = _FLAG_TYPES[flag]
     raw = _np.frombuffer(f.read(dt.itemsize * n_elems), dtype=dt)
-    if _np.dtype(dt).itemsize == 8:
-        # 64-bit payloads (reference int64/float64 .params): jax's x32
-        # default would silently truncate/wrap the loaded values
-        import jax
+    from ..base import x64_scope_if
 
-        with jax.enable_x64(True):
-            return jnp.asarray(raw.reshape(shape))
-    return jnp.asarray(raw.reshape(shape))
+    # 64-bit payloads (reference int64/float64 .params): jax's x32
+    # default would silently truncate/wrap the loaded values
+    with x64_scope_if(dt):
+        return jnp.asarray(raw.reshape(shape))
 
 
 def _flag_and_raw(a):

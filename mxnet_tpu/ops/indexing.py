@@ -18,9 +18,9 @@ def take(a, indices, axis=0, mode="clip"):
     if a.shape[axis] > 2 ** 31 - 1:
         # large-tensor gather (INT64_TENSOR_SIZE): int32 index carry
         # would silently truncate — run the gather under x64
-        import jax
+        from ..base import x64_scope
 
-        with jax.enable_x64(True):
+        with x64_scope(True):
             return jnp.take(a, indices.astype(jnp.int64), axis=axis,
                             mode=m)
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)

@@ -306,3 +306,28 @@ def test_64bit_creators_and_casts():
     a = nd.array(np.array([2.2e9]), dtype="float64")
     assert int(a.astype("int64").asnumpy()[0]) == 2_200_000_000
     assert int(nd.cast(a, dtype="int64").asnumpy()[0]) == 2_200_000_000
+
+
+def test_64bit_pickle_setitem_linspace_eye():
+    """Review regressions: pickle round-trip, large scalar setitem into
+    int64, linspace/eye 64-bit dtypes, and x64 getitem on the tape."""
+    import pickle
+
+    from mxnet_tpu import autograd
+
+    a = nd.array(np.array([2_199_999_999], np.int64), dtype="int64")
+    b = pickle.loads(pickle.dumps(a))
+    assert b.dtype == np.int64
+    assert int(b.asnumpy()[0]) == 2_199_999_999
+    c = nd.zeros((4,), dtype="int64")
+    c[0] = 2_200_000_000
+    assert int(c.asnumpy()[0]) == 2_200_000_000
+    lin = nd.linspace(0, 1e300, 3, dtype="float64")
+    assert lin.dtype == np.float64 and np.isfinite(lin.asnumpy()[-1])
+    assert nd.eye(3, dtype="int64").dtype == np.int64
+    x = nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:4] * 2).sum()
+    y.backward()
+    assert list(x.grad.asnumpy()) == [0, 2, 2, 2, 0, 0]
