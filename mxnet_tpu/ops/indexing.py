@@ -111,8 +111,10 @@ def scatter_nd(data, indices, shape=None):
     return out.at[idx].set(data)
 
 
-@register("index_copy")
+@register("_contrib_index_copy", aliases=("index_copy",))
 def index_copy(old, index, new):
+    """Reference: contrib/index_copy.cc (out-of-place here — the
+    reference mutates via out=)."""
     return old.at[index.astype(jnp.int32)].set(new)
 
 
@@ -121,7 +123,7 @@ def index_add(old, index, new):
     return old.at[index.astype(jnp.int32)].add(new)
 
 
-@register("boolean_mask")
+@register("_contrib_boolean_mask", aliases=("boolean_mask",))
 def boolean_mask(data, index, axis=0):
     # Dynamic-shape op in the reference (src/operator/contrib/boolean_mask.cc).
     # XLA needs static shapes: we keep full size and compact valid rows to the
@@ -206,3 +208,24 @@ def ravel_multi_index(data, shape=None):
     strides = jnp.asarray(list(reversed(strides)), data.dtype)
     return jnp.sum(data * strides.reshape((-1,) + (1,) * (data.ndim - 1)),
                    axis=0)
+
+
+@register("_contrib_index_array", aliases=("index_array",))
+def index_array(data, axes=None):
+    """Per-element coordinate array (reference: contrib/index_array.cc):
+    output shape = data.shape + (len(axes),), entry = the element's
+    index along each requested axis (default: all axes)."""
+    sel = tuple(range(data.ndim)) if axes is None \
+        else tuple(int(a) for a in axes)
+    coords = [jnp.broadcast_to(
+        jnp.arange(data.shape[a]).reshape(
+            (1,) * a + (-1,) + (1,) * (data.ndim - a - 1)),
+        data.shape) for a in sel]
+    return jnp.stack(coords, axis=-1).astype(jnp.int64)
+
+
+@register("_contrib_allclose", aliases=("allclose",))
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Scalar 1.0/0.0 closeness test (reference: contrib/allclose_op.cc)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=bool(equal_nan)).astype(jnp.float32)

@@ -247,12 +247,14 @@ SPECS = {
                                          np.float32).T], fd=False),
     "scatter_nd": Spec([N(2), np.array([[0, 2]], np.float32),
                         ], {"shape": (4,)}, fd=False),
-    "index_copy": Spec([N(5, 3), np.array([1, 3], np.float32), N(2, 3)],
-                       fd=False),
+    "_contrib_index_copy": Spec(
+        [N(5, 3), np.array([1, 3], np.float32), N(2, 3)], fd=False),
     "index_add": Spec([N(5, 3), np.array([1, 3], np.float32), N(2, 3)],
                       fd=True, fd_argnums=[0, 2]),
-    "boolean_mask": Spec([N(4, 3),
-                          np.array([1, 0, 1, 1], np.float32)], fd=False),
+    "_contrib_boolean_mask": Spec(
+        [N(4, 3), np.array([1, 0, 1, 1], np.float32)], fd=False),
+    "_contrib_index_array": Spec([N(2, 3)], fd=False),
+    "_contrib_allclose": Spec([N(2, 3), N(2, 3)], fd=False),
     "SequenceMask": Spec([N(4, 2, 3), np.array([2, 4], np.float32)],
                          {"use_sequence_length": True}, fd=True,
                          fd_argnums=[0]),
