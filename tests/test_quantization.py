@@ -182,3 +182,25 @@ def test_quantize_transformer_gpt():
     assert nq >= 8, nq  # the FFN + projection matmuls went int8
     agree = (qo[:, -1].argmax(-1) == ref[:, -1].argmax(-1)).mean()
     assert agree == 1.0, agree
+
+
+def test_quantize_net_vit():
+    """int8 PTQ generalizes to the ViT family (patchify conv + scanned
+    trunk): traced matmuls rewrite, argmax agreement holds."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = vision.vit_tiny()
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 3, 32, 32)
+                    .astype(np.float32))
+    net(x)
+    qnet = quantize_net(net, calib_data=[x], calib_mode="naive")
+    agree = (qnet(x).asnumpy().argmax(1)
+             == net(x).asnumpy().argmax(1)).mean()
+    assert agree >= 0.75, agree
