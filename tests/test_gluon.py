@@ -464,3 +464,14 @@ def test_lora_on_hybridized_attribute_held_net():
 
     with _pytest.raises(ValueError):
         apply_lora(net, rank=2, patterns=("no_match_pattern",))
+
+    # the adapted net exports and round-trips through SymbolBlock
+    with autograd.predict_mode():
+        ref_exp = net(x)
+    d = tempfile.mkdtemp()
+    net.export(os.path.join(d, "lora"))
+    sb = gluon.SymbolBlock.imports(
+        os.path.join(d, "lora-symbol.json"), ["data"],
+        os.path.join(d, "lora-0000.params"))
+    np.testing.assert_allclose(sb(x).asnumpy(), ref_exp.asnumpy(),
+                               atol=1e-5)
