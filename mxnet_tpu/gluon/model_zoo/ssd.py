@@ -84,12 +84,14 @@ class SSD(HybridBlock):
             anchors.append(gen(x))
             c = cls_head(x)          # (B, A*(C+1), H, W)
             l = loc_head(x)          # (B, A*4, H, W)
-            B = c.shape[0]
+            # shape-free reshape (0 = keep batch) so the graph traces
+            # symbolically for export
             cls_preds.append(
                 F.reshape(F.transpose(c, axes=(0, 2, 3, 1)),
-                          (B, -1, self.num_classes + 1)))
+                          shape=(0, -1, self.num_classes + 1)))
             loc_preds.append(
-                F.reshape(F.transpose(l, axes=(0, 2, 3, 1)), (B, -1)))
+                F.reshape(F.transpose(l, axes=(0, 2, 3, 1)),
+                          shape=(0, -1)))
         cls_all = F.concat(*cls_preds, dim=1)     # (B, N, C+1)
         loc_all = F.concat(*loc_preds, dim=1)     # (B, N*4)
         anc_all = F.concat(*anchors, dim=1)       # (1, N, 4)

@@ -722,3 +722,29 @@ def test_bert_trunk_lora_wires():
     assert 0 < n_train < 0.05 * n_total
     with pytest.raises(ValueError):
         bert.bert_tiny(lora_rank=2)  # scan_layers=False default
+
+
+def test_ssd_export_roundtrip(tmp_path):
+    """SSD exports symbolically (shape-free head reshapes) and
+    SymbolBlock round-trips all three outputs."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import SSD
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = SSD(num_classes=2)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).randn(1, 3, 64, 64)
+                    .astype(np.float32))
+    net(x)
+    with autograd.predict_mode():
+        ref = net(x)
+    net.export(str(tmp_path / "ssd"))
+    sb = gluon.SymbolBlock.imports(
+        str(tmp_path / "ssd-symbol.json"), ["data"],
+        str(tmp_path / "ssd-0000.params"))
+    out = sb(x)
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(o.asnumpy(), r.asnumpy(), atol=1e-5)
