@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Neural style transfer (reference: example/neural-style — Gatys et
+al. 2015, the classic optimize-the-image example).
+
+A small randomly-initialized VGG-style conv stack provides the feature
+maps (the reference downloads VGG-19 weights; zero-egress here — random
+features still define valid content/Gram-style objectives, which is all
+the optimization loop needs).  The IMAGE is the parameter: autograd
+drives pixels to match content features + style Gram matrices.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+
+
+def feature_net():
+    """Conv tower exposing per-block activations."""
+    blocks = []
+    net = nn.HybridSequential()
+    for i, ch in enumerate((16, 32, 64)):
+        blk = nn.HybridSequential(prefix=f"b{i}_")
+        with blk.name_scope():
+            blk.add(nn.Conv2D(ch, 3, padding=1, activation="relu"))
+            if i:
+                blk.add(nn.AvgPool2D(2))
+        net.add(blk)
+        blocks.append(blk)
+    return net, blocks
+
+
+def extract(blocks, x):
+    feats = []
+    h = x
+    for blk in blocks:
+        h = blk(h)
+        feats.append(h)
+    return feats
+
+
+def gram(f):
+    B, C = f.shape[0], f.shape[1]
+    flat = f.reshape((B, C, -1))
+    return mx.nd.batch_dot(flat, flat.transpose((0, 2, 1))) \
+        / (f.shape[2] * f.shape[3])
+
+
+def synthetic_images(size):
+    """Content: centered bright square.  Style: diagonal stripes."""
+    yy, xx = np.mgrid[0:size, 0:size]
+    content = np.zeros((1, 3, size, size), np.float32)
+    content[:, :, size // 4:3 * size // 4, size // 4:3 * size // 4] = 0.8
+    style = np.tile(((yy + xx) % 8 < 4).astype(np.float32),
+                    (1, 3, 1, 1)) * 0.9
+    return content, style
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=32)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--style-weight", type=float, default=50.0)
+    args = parser.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net, blocks = feature_net()
+    net.initialize(init=mx.init.Xavier())
+
+    c_np, s_np = synthetic_images(args.size)
+    content, style = mx.nd.array(c_np), mx.nd.array(s_np)
+    with autograd.predict_mode():
+        c_feats = extract(blocks, content)
+        s_grams = [gram(f) for f in extract(blocks, style)]
+
+    img = mx.nd.array(np.random.uniform(0.3, 0.7,
+                                        c_np.shape).astype(np.float32))
+    img.attach_grad()
+    # the IMAGE is the parameter: Adam through the updater API
+    # (reference uses mx.optimizer the same way; adaptive scaling
+    # matters — raw feature gradients are tiny)
+    updater = mx.optimizer.get_updater(
+        mx.optimizer.create("adam", learning_rate=args.lr))
+
+    first = last = None
+    for step in range(args.steps):
+        with autograd.record():
+            feats = extract(blocks, img)
+            # content: deepest block; style: gram of every block
+            c_loss = ((feats[-1] - c_feats[-1]) ** 2).mean()
+            s_loss = sum(((gram(f) - g) ** 2).mean()
+                         for f, g in zip(feats, s_grams))
+            loss = c_loss + args.style_weight * s_loss
+        loss.backward()
+        updater(0, img.grad, img)
+        img._set_data(img.clip(0, 1)._data)
+        img.grad[:] = 0
+        v = float(loss.asnumpy())
+        first = v if first is None else first
+        last = v
+        if step % 20 == 0:
+            print(f"step {step}: loss {v:.5f} "
+                  f"(content {float(c_loss.asnumpy()):.5f})")
+
+    print(f"loss first {first:.5f} -> last {last:.5f}")
+    print("neural style OK" if last < 0.5 * first
+          else "neural style did not converge")
+    if last >= 0.5 * first:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -116,3 +116,8 @@ def test_example_matrix_factorization():
     out = _run("matrix_factorization.py", "--steps", "150", timeout=500)
     assert "matrix factorization OK" in out
     assert "stype=row_sparse" in out
+
+
+def test_example_neural_style():
+    out = _run("neural_style.py", "--steps", "50", timeout=500)
+    assert "neural style OK" in out
