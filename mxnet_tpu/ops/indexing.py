@@ -216,12 +216,17 @@ def index_array(data, axes=None):
     output shape = data.shape + (len(axes),), entry = the element's
     index along each requested axis (default: all axes)."""
     sel = tuple(range(data.ndim)) if axes is None \
-        else tuple(int(a) for a in axes)
+        else tuple(int(a) % data.ndim for a in axes)  # negatives OK
     coords = [jnp.broadcast_to(
         jnp.arange(data.shape[a]).reshape(
             (1,) * a + (-1,) + (1,) * (data.ndim - a - 1)),
         data.shape) for a in sel]
-    return jnp.stack(coords, axis=-1).astype(jnp.int64)
+    from ..base import x64_scope
+
+    # reference output dtype is int64 — needs the x64 scope or jax's
+    # x32 default silently downcasts the astype
+    with x64_scope(True):
+        return jnp.stack(coords, axis=-1).astype(jnp.int64)
 
 
 @register("_contrib_allclose", aliases=("allclose",))

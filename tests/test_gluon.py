@@ -468,10 +468,10 @@ def test_lora_on_hybridized_attribute_held_net():
     # the adapted net exports and round-trips through SymbolBlock
     with autograd.predict_mode():
         ref_exp = net(x)
-    d = tempfile.mkdtemp()
-    net.export(os.path.join(d, "lora"))
-    sb = gluon.SymbolBlock.imports(
-        os.path.join(d, "lora-symbol.json"), ["data"],
-        os.path.join(d, "lora-0000.params"))
-    np.testing.assert_allclose(sb(x).asnumpy(), ref_exp.asnumpy(),
-                               atol=1e-5)
+    with tempfile.TemporaryDirectory() as d:
+        net.export(os.path.join(d, "lora"))
+        sb = gluon.SymbolBlock.imports(
+            os.path.join(d, "lora-symbol.json"), ["data"],
+            os.path.join(d, "lora-0000.params"))
+        np.testing.assert_allclose(sb(x).asnumpy(), ref_exp.asnumpy(),
+                                   atol=1e-5)

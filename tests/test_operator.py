@@ -254,7 +254,9 @@ SPECS = {
     "_contrib_boolean_mask": Spec(
         [N(4, 3), np.array([1, 0, 1, 1], np.float32)], fd=False),
     "_contrib_index_array": Spec([N(2, 3)], fd=False),
-    "_contrib_allclose": Spec([N(2, 3), N(2, 3)], fd=False),
+    "_contrib_allclose": Spec(
+        [(_ac := N(2, 3)), _ac.copy()], fd=False,
+        ref=lambda a, b: np.float32(np.allclose(a, b))),  # close -> 1.0
     "SequenceMask": Spec([N(4, 2, 3), np.array([2, 4], np.float32)],
                          {"use_sequence_length": True}, fd=True,
                          fd_argnums=[0]),
