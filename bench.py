@@ -52,32 +52,53 @@ _RESNET50_TRAIN_FLOPS_224 = 3.0 * 2 * 4.089e9
 _MFU_GATE = 0.95
 
 
+def _load_resilience():
+    """Load mxnet_tpu/resilience.py WITHOUT importing the package — the
+    orchestrator must stay jax-free (module contract above)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "mxnet_tpu", "resilience.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_resilience",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _probe_backend():
     """Cheap tunnel-liveness probe (VERDICT r3 task #1a).
 
     A dead axon tunnel hangs ``jax.devices()`` for hours; burning the
     full worker budgets on it is how round 3 ended as ``rc: 124`` with
-    no JSON at all.  A ≤90s subprocess probe decides up front whether
-    the TPU attempts are worth their budgets; on failure the
-    orchestrator goes straight to the CPU fallback and still emits a
-    valid JSON line.
+    no JSON at all.  A resilience.Watchdog supervises the probe
+    subprocess (round 5's ad-hoc 90s timeout, structured): on expiry it
+    dumps the orchestrator's thread stacks to stderr, kills the wedged
+    child, and the JSON line carries a structured ``tpu_probe`` error
+    instead of a bare timeout string.
     """
     timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
     code = ("import jax, json; d = jax.devices(); "
             "print(json.dumps({'platform': d[0].platform, "
             "'kind': getattr(d[0], 'device_kind', '')}))")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              timeout=timeout, capture_output=True,
-                              text=True)
-    except subprocess.TimeoutExpired:
-        return {"ok": False, "reason": f"backend probe timed out after "
-                                       f"{timeout}s (tunnel down?)"}
+    resilience = _load_resilience()
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    wd = resilience.Watchdog(timeout, name="tpu_probe", action="none",
+                             on_expire=proc.kill)
+    with wd:
+        out, err = proc.communicate()
+    if wd.expired:
+        return {"ok": False,
+                "reason": f"tpu_probe watchdog expired after {timeout}s "
+                          f"(tunnel wedged?); probe killed, thread "
+                          f"stacks dumped to stderr"}
     if proc.returncode != 0:
-        tail = (proc.stderr or "").strip()[-200:]
+        tail = (err or "").strip()[-200:]
         return {"ok": False,
                 "reason": f"probe rc={proc.returncode}: {tail}"}
-    for ln in reversed(proc.stdout.strip().splitlines()):
+    for ln in reversed(out.strip().splitlines()):
         try:
             obj = json.loads(ln)
         except (ValueError, TypeError):

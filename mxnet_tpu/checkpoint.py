@@ -61,6 +61,11 @@ class ShardedCheckpointer:
     def latest_step(self):
         return self._mgr.latest_step()
 
+    def all_steps(self):
+        """All retained checkpoint steps, ascending (resilience.py walks
+        these newest-first when the latest is corrupt/partial)."""
+        return sorted(self._mgr.all_steps())
+
     def wait(self):
         """Block until async saves finish."""
         self._mgr.wait_until_finished()
@@ -102,17 +107,28 @@ def load_trainer_state(trainer, state):
 class PreemptionHandler:
     """Checkpoint on SIGTERM (TPU preemption notice).  Reference story is
     'restart from the last epoch checkpoint' (SURVEY §5.3); on TPU we get
-    a grace window — snapshot mid-epoch state and exit cleanly."""
+    a grace window — snapshot mid-epoch state and exit cleanly.
+
+    Usable as a context manager (``with PreemptionHandler(...):``), and
+    chains to any previously-installed SIGTERM handler so stacking with
+    an outer supervisor (e.g. a launcher's own grace logic) keeps both
+    alive."""
 
     def __init__(self, checkpointer, get_state, get_step):
         self._ckpt = checkpointer
         self._get_state = get_state
         self._get_step = get_step
         self.preempted = threading.Event()
+        self._restored = False
         self._prev = signal.signal(signal.SIGTERM, self._on_sigterm)
 
     def _on_sigterm(self, signum, frame):
         self.preempted.set()
+        # chain: a previously-installed python handler still runs (the
+        # reference bug was dropping it — an outer supervisor's grace
+        # logic silently disabled)
+        if callable(self._prev):
+            self._prev(signum, frame)
 
     def maybe_checkpoint(self):
         """Call at step boundaries; saves + returns True when preempted."""
@@ -123,4 +139,18 @@ class PreemptionHandler:
         return True
 
     def restore_handler(self):
-        signal.signal(signal.SIGTERM, self._prev)
+        if self._restored:
+            return
+        # signal.signal rejects None (getsignal returns None for handlers
+        # not installed from python) — fall back to the default action
+        signal.signal(signal.SIGTERM,
+                      self._prev if self._prev is not None
+                      else signal.SIG_DFL)
+        self._restored = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.restore_handler()
+        return False

@@ -10,13 +10,45 @@ MXTPU_WORKER_RANK, set by tools/launch.py.  After init, every process sees
 the global device set and collectives span hosts over ICI/DCN
 automatically.  Checkpoint-restart is the recovery primitive (SURVEY.md
 §5.3: elasticity is out of scope, matching the reference).
+
+Robustness (resilience.py): a coordinator that is slow to come up — the
+normal case when a relaunched gang races its rank-0 — is retried with
+exponential backoff under ``MXTPU_RENDEZVOUS_RETRIES`` attempts /
+``MXTPU_RENDEZVOUS_TIMEOUT`` seconds total; ``distributed.barrier`` arms
+a watchdog from ``MXTPU_COLLECTIVE_TIMEOUT`` so a dead peer produces a
+stack dump and a clean error instead of an infinite hang.
 """
 
 from __future__ import annotations
 
 import os
 
+from . import resilience
+
 _INITIALIZED = False
+
+
+def _rendezvous(coordinator_address, num_processes, process_id):
+    """One retried rendezvous attempt loop (coordinator-unreachable is
+    the retryable class; the MXTPU_FAULT_INJECT 'rendezvous' site tests
+    it hermetically)."""
+    import jax
+
+    timeout = float(os.environ.get("MXTPU_RENDEZVOUS_TIMEOUT", 300))
+    retries = int(os.environ.get("MXTPU_RENDEZVOUS_RETRIES", 3))
+
+    def attempt():
+        resilience.inject_failure("rendezvous")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+    resilience.retry_call(
+        attempt, retries=retries, deadline=timeout, backoff=0.5,
+        max_backoff=10.0,
+        retryable=(RuntimeError, ConnectionError, OSError,
+                   resilience.InjectedFault),
+        description=f"rendezvous with {coordinator_address}")
 
 
 def init_from_env():
@@ -28,12 +60,8 @@ def init_from_env():
     coord = os.environ.get("MXTPU_COORDINATOR")
     if not coord:
         return False
-    import jax
-
-    jax.distributed.initialize(
-        coordinator_address=coord,
-        num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
-        process_id=int(os.environ["MXTPU_WORKER_RANK"]))
+    _rendezvous(coord, int(os.environ["MXTPU_NUM_WORKERS"]),
+                int(os.environ["MXTPU_WORKER_RANK"]))
     _INITIALIZED = True
     return True
 
@@ -42,10 +70,7 @@ def initialize(coordinator_address=None, num_processes=None,
                process_id=None):
     """Explicit init (reference analog: ps::Postoffice::Start)."""
     global _INITIALIZED
-    import jax
-
-    jax.distributed.initialize(coordinator_address, num_processes,
-                               process_id)
+    _rendezvous(coordinator_address, num_processes, process_id)
     _INITIALIZED = True
 
 
@@ -61,7 +86,44 @@ def num_workers():
     return jax.process_count()
 
 
-def barrier(name="mxtpu_barrier"):
-    from jax.experimental import multihost_utils
+_BARRIER_N = 0
 
-    multihost_utils.sync_global_devices(name)
+
+def _coordination_client():
+    """The process's coordination-service client, or None when not
+    running distributed (single process / uninitialized)."""
+    try:
+        from jax._src import distributed as _jdist
+
+        return _jdist.global_state.client
+    except Exception:
+        return None
+
+
+def barrier(name="mxtpu_barrier"):
+    """Block until every process reaches this barrier.
+
+    Multi-process: uses the coordination-service barrier (gRPC via the
+    rendezvous coordinator) — backend-agnostic, so it works where XLA
+    cross-process collectives don't exist (the CPU backend used by the
+    hermetic 2-process tests).  Single-process: sync_global_devices,
+    which also drains in-flight device work.
+
+    Guarded by MXTPU_COLLECTIVE_TIMEOUT: a dead peer produces a stack
+    dump and a clean error/abort instead of an infinite hang; the
+    barrier's own RPC deadline (2x the watchdog, 1800s unguarded) is the
+    defense-in-depth behind it.
+    """
+    global _BARRIER_N
+    with resilience.guard_collective(f"barrier:{name}"):
+        client = _coordination_client()
+        if client is not None:
+            _BARRIER_N += 1
+            timeout = float(
+                os.environ.get("MXTPU_COLLECTIVE_TIMEOUT") or 900)
+            client.wait_at_barrier(f"mxtpu:{name}#{_BARRIER_N}",
+                                   timeout_in_ms=int(timeout * 2000))
+        else:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)

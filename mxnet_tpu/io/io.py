@@ -21,6 +21,7 @@ from collections import namedtuple
 
 import numpy as _np
 
+from .. import resilience
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _from_jax
 
@@ -482,11 +483,16 @@ class CSVIter(DataIter):
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, dtype="float32", **kwargs):
         super().__init__(batch_size)
-        data = _np.loadtxt(data_csv, delimiter=",",
-                           dtype=dtype).reshape((-1,) + tuple(data_shape))
+        data = resilience.io_retry(
+            lambda: _np.loadtxt(data_csv, delimiter=",", dtype=dtype),
+            description=f"read {data_csv}")
+        data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype)
+            label = resilience.io_retry(
+                lambda: _np.loadtxt(label_csv, delimiter=",",
+                                    dtype=dtype),
+                description=f"read {label_csv}")
             label = label.reshape((-1,) + tuple(label_shape))
             if label_shape == (1,):
                 label = label.reshape(-1)
@@ -530,11 +536,14 @@ class MNISTIter(DataIter):
 
     @staticmethod
     def _open(path):
-        if path.endswith(".gz") or (not os.path.exists(path)
-                                    and os.path.exists(path + ".gz")):
-            return gzip.open(path if path.endswith(".gz") else path + ".gz",
-                             "rb")
-        return open(path, "rb")
+        def opener():
+            if path.endswith(".gz") or (not os.path.exists(path)
+                                        and os.path.exists(path + ".gz")):
+                return gzip.open(
+                    path if path.endswith(".gz") else path + ".gz", "rb")
+            return open(path, "rb")
+
+        return resilience.io_retry(opener, description=f"open {path}")
 
     def _read_images(self, path):
         with self._open(path) as f:
@@ -570,13 +579,17 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  resize=-1, round_batch=True, preprocess_threads=4,
-                 prefetch_buffer=4, dtype="float32", **kwargs):
+                 prefetch_buffer=4, dtype="float32", skip_corrupt=False,
+                 **kwargs):
         super().__init__(batch_size)
         from .. import recordio as rio
         from .. import image as img_mod
 
-        self._rec = rio.MXRecordIO(path_imgrec, "r") if path_imgidx is None \
-            else rio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        self._rec = rio.MXRecordIO(path_imgrec, "r",
+                                   skip_corrupt=skip_corrupt) \
+            if path_imgidx is None \
+            else rio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r",
+                                       skip_corrupt=skip_corrupt)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -738,7 +751,8 @@ class LibSVMIter(DataIter):
         self._dim = dim
         self._stype = stype
         vals, cols, indptr, labels = [], [], [0], []
-        with open(data_libsvm) as f:
+        with resilience.io_retry(lambda: open(data_libsvm),
+                                 description=f"open {data_libsvm}") as f:
             for line in f:
                 parts = line.strip().split()
                 if not parts:

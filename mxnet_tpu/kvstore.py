@@ -21,6 +21,7 @@ import pickle
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, _from_jax
 from . import optimizer as opt
+from . import resilience
 
 
 def _as_list(x):
@@ -82,11 +83,15 @@ def _cross_process_allreduce(raw):
         entry = (mesh, in_s, out_s, fn)
         _ALLREDUCE_CACHE[key] = entry
     mesh, in_s, out_s, fn = entry
-    garr = multihost_utils.host_local_array_to_global_array(
-        jnp.asarray(raw)[None], mesh, PartitionSpec("w"))
-    out = fn(garr)
-    return multihost_utils.global_array_to_host_local_array(
-        out, mesh, PartitionSpec())
+    # watchdog around the blocking exchange: a dead peer stalls the
+    # all-reduce forever; MXTPU_COLLECTIVE_TIMEOUT turns that into a
+    # stack dump + clean error/abort (resilience.py)
+    with resilience.guard_collective("kvstore_allreduce"):
+        garr = multihost_utils.host_local_array_to_global_array(
+            jnp.asarray(raw)[None], mesh, PartitionSpec("w"))
+        out = fn(garr)
+        return multihost_utils.global_array_to_host_local_array(
+            out, mesh, PartitionSpec())
 
 
 def _cross_process_f16_allreduce(h16):
@@ -115,11 +120,12 @@ def _cross_process_f16_allreduce(h16):
         entry = (mesh, fn)
         _ALLREDUCE_CACHE[key] = entry
     mesh, fn = entry
-    garr = multihost_utils.host_local_array_to_global_array(
-        jnp.asarray(h16)[None], mesh, PartitionSpec("w"))
-    out = fn(garr)
-    return multihost_utils.global_array_to_host_local_array(
-        out, mesh, PartitionSpec())
+    with resilience.guard_collective("kvstore_f16_allreduce"):
+        garr = multihost_utils.host_local_array_to_global_array(
+            jnp.asarray(h16)[None], mesh, PartitionSpec("w"))
+        out = fn(garr)
+        return multihost_utils.global_array_to_host_local_array(
+            out, mesh, PartitionSpec())
 
 
 def _cross_process_compressed_allreduce(packed, n, threshold, dtype):
@@ -151,11 +157,12 @@ def _cross_process_compressed_allreduce(packed, n, threshold, dtype):
         entry = (mesh, fn)
         _ALLREDUCE_CACHE[key] = entry
     mesh, fn = entry
-    garr = multihost_utils.host_local_array_to_global_array(
-        jnp.asarray(packed)[None], mesh, PartitionSpec("w"))
-    out = fn(garr)
-    return multihost_utils.global_array_to_host_local_array(
-        out, mesh, PartitionSpec())
+    with resilience.guard_collective("kvstore_2bit_allreduce"):
+        garr = multihost_utils.host_local_array_to_global_array(
+            jnp.asarray(packed)[None], mesh, PartitionSpec("w"))
+        out = fn(garr)
+        return multihost_utils.global_array_to_host_local_array(
+            out, mesh, PartitionSpec())
 
 
 class KVStore:
@@ -366,7 +373,8 @@ class KVStore:
         if self._is_dist and self.num_workers > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("kvstore_barrier")
+            with resilience.guard_collective("kvstore_barrier"):
+                multihost_utils.sync_global_devices("kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
         pass

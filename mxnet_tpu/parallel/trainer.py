@@ -383,12 +383,33 @@ class ShardedTrainer:
         t = self._num_update
         lr = self.optimizer.lr_at(t)
         key = _random.next_key()
-        self._param_vals, self._opt_state, self._aux_vals, loss = \
-            self._step_fn(self._param_vals, self._opt_state,
-                          self._aux_vals, x, y, key,
-                          jnp.asarray(lr, jnp.float32),
-                          jnp.asarray(t, jnp.float32))
+        # MXTPU_STEP_TIMEOUT arms a watchdog around the dispatch: a step
+        # wedged inside the runtime (dead tunnel, stuck collective) dumps
+        # thread stacks and errors out instead of hanging the driver
+        from .. import resilience
+
+        with resilience.guard_step(f"train_step {t}"):
+            self._param_vals, self._opt_state, self._aux_vals, loss = \
+                self._step_fn(self._param_vals, self._opt_state,
+                              self._aux_vals, x, y, key,
+                              jnp.asarray(lr, jnp.float32),
+                              jnp.asarray(t, jnp.float32))
         return _from_jax(loss)
+
+    def state_dict(self):
+        """Full train state as a pytree (params + optimizer + step) for
+        checkpointing; valid after the first step (or _stage).  The
+        resilience.run_resilient get_state hook for sharded training."""
+        from .. import checkpoint
+
+        return checkpoint.trainer_state(self)
+
+    def load_state_dict(self, state):
+        """Load a state_dict()/checkpoint pytree back onto the mesh (the
+        run_resilient set_state hook)."""
+        from .. import checkpoint
+
+        checkpoint.load_trainer_state(self, state)
 
     def sync_params(self):
         """Write the mesh-resident values back into the gluon Parameters

@@ -29,6 +29,7 @@ import struct
 
 import numpy as np
 
+from . import resilience
 from .base import MXNetError
 
 _MAGIC = 0xced7230a
@@ -45,11 +46,18 @@ def _decode_lrec(data):
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer (reference: mx.recordio.MXRecordIO)."""
+    """Sequential .rec reader/writer (reference: mx.recordio.MXRecordIO).
 
-    def __init__(self, uri, flag):
+    ``skip_corrupt=True`` makes the reader tolerate corruption: a bad
+    magic resyncs to the next aligned magic, a truncated tail reads as
+    EOF, and an injected-corrupt record is skipped — each with a warning.
+    The default is strict (raise MXNetError), matching the reference.
+    """
+
+    def __init__(self, uri, flag, skip_corrupt=False):
         self.uri = uri
         self.flag = flag
+        self.skip_corrupt = skip_corrupt
         self.handle = None
         self.is_open = False
         self.open()
@@ -58,15 +66,20 @@ class MXRecordIO:
         if self.flag == "w":
             # a re-open (unpickle / fork reset) must NOT truncate what was
             # already written — append instead
-            self.handle = open(self.uri, "ab" if _reopen else "wb")
+            self.handle = resilience.io_retry(
+                lambda: open(self.uri, "ab" if _reopen else "wb"),
+                description=f"open {self.uri}")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            self.handle = resilience.io_retry(
+                lambda: open(self.uri, "rb"),
+                description=f"open {self.uri}")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.pid = os.getpid()
         self.is_open = True
+        self._nread = 0
 
     def close(self):
         if not self.is_open:
@@ -145,11 +158,49 @@ class MXRecordIO:
         if pad:
             self.handle.write(b"\x00" * pad)
 
+    def _corrupt(self, msg):
+        """Corruption policy gate: strict raises; skip_corrupt warns and
+        returns True so the caller can skip/resync."""
+        if not self.skip_corrupt:
+            raise MXNetError(msg)
+        import warnings
+
+        warnings.warn(f"RecordIO: {msg}; skipping (skip_corrupt=True)",
+                      stacklevel=3)
+        return True
+
+    def _resync(self, magic_bytes):
+        """Scan forward to the next 4-byte-ALIGNED magic (framing is
+        aligned, so any real record header lands there); returns False at
+        EOF.  Only reachable in skip_corrupt mode."""
+        pos = self.handle.tell() - 4  # re-examine the 2nd header word
+        pos += (-pos) % 4
+        self.handle.seek(pos)
+        while True:
+            chunk_start = self.handle.tell()
+            chunk = self.handle.read(1 << 16)
+            if not chunk:
+                return False
+            i = chunk.find(magic_bytes)
+            while i != -1:
+                if (chunk_start + i) % 4 == 0:
+                    self.handle.seek(chunk_start + i)
+                    return True
+                i = chunk.find(magic_bytes, i + 1)
+            # a magic may straddle the chunk boundary
+            self.handle.seek(chunk_start + max(1, len(chunk) - 3))
+
     def read(self):
         """Read one record; None at EOF.
 
         Re-inserts the excised kMagic before every continuation (cflag
         2/3) chunk — dmlc-core RecordIOReader::NextRecord semantics.
+
+        Corruption detection: a bad magic, a partial trailing header, or
+        a short payload read (truncated tail) hits the ``skip_corrupt``
+        policy — strict raise by default, warn+skip/resync when enabled.
+        The ``corrupt_record:K`` fault-injection site makes the K-th
+        record of this reader read as corrupt (hermetic test hook).
         """
         assert not self.writable
         self._check_pid(allow_reset=True)
@@ -158,27 +209,54 @@ class MXRecordIO:
         while True:
             header = self.handle.read(8)
             if len(header) < 8:
-                if out is not None:
-                    raise MXNetError(f"truncated RecordIO file {self.uri}")
+                if len(header) == 0 and out is None:
+                    return None  # clean EOF
+                self._corrupt(
+                    f"truncated RecordIO tail in {self.uri} "
+                    f"({len(header)} trailing header bytes)")
                 return None
             magic, lrec = struct.unpack("<II", header)
             if magic != _MAGIC:
-                raise MXNetError(f"Invalid RecordIO magic in {self.uri}")
+                self._corrupt(
+                    f"Invalid RecordIO magic in {self.uri} at offset "
+                    f"{self.handle.tell() - 8}")
+                out = None
+                if self._resync(magic_bytes):
+                    continue
+                return None
             cflag, length = _decode_lrec(lrec)
             data = self.handle.read(length)
+            if len(data) < length:
+                self._corrupt(
+                    f"truncated RecordIO record in {self.uri} (want "
+                    f"{length} payload bytes, got {len(data)})")
+                return None
             self._skip_pad(length)
+            complete = None
             if cflag == 0:
-                return data
-            if cflag == 1:
+                complete = data
+            elif cflag == 1:
                 out = data
+                continue
             elif out is None:
-                raise MXNetError(
+                self._corrupt(
                     f"RecordIO continuation chunk without start in "
                     f"{self.uri}")
+                continue  # skip mode: drop the orphan chunk, keep going
             else:
                 out += magic_bytes + data
-            if cflag == 3:
-                return out
+                if cflag != 3:
+                    continue
+                complete = out
+                out = None
+            idx = self._nread
+            self._nread += 1
+            if resilience.fault_arg("corrupt_record") == idx and \
+                    resilience.consume_fault("corrupt_record"):
+                self._corrupt(
+                    f"injected corrupt record {idx} in {self.uri}")
+                continue  # skip mode: drop the poisoned record
+            return complete
 
     def _skip_pad(self, length):
         pad = (4 - length % 4) % 4
@@ -202,20 +280,23 @@ class MXIndexedRecordIO(MXRecordIO):
     """Random-access .rec with .idx sidecar (reference:
     mx.recordio.MXIndexedRecordIO)."""
 
-    def __init__(self, idx_path, uri, flag, key_type=int):
+    def __init__(self, idx_path, uri, flag, key_type=int,
+                 skip_corrupt=False):
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
         self.key_type = key_type
         self.fidx = None
-        super().__init__(uri, flag)
+        super().__init__(uri, flag, skip_corrupt=skip_corrupt)
 
     def open(self, _reopen=False):
         super().open(_reopen=_reopen)
         self.idx = {}
         self.keys = []
         if self.flag == "r" and os.path.isfile(self.idx_path):
-            self.fidx = open(self.idx_path, "r")
+            self.fidx = resilience.io_retry(
+                lambda: open(self.idx_path, "r"),
+                description=f"open {self.idx_path}")
             for line in iter(self.fidx.readline, ""):
                 line = line.strip().split("\t")
                 key = self.key_type(line[0])

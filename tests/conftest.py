@@ -43,6 +43,30 @@ if shutil.which("make") and shutil.which("g++"):
         subprocess.run(["make", "-k", "-C", _SRC], capture_output=True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 "
+                   "(`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers", "faults: CPU-hermetic fault-injection tests driven "
+                   "by MXTPU_FAULT_INJECT (run in tier-1 by default)")
+
+
+@pytest.fixture
+def fault_inject(monkeypatch):
+    """Arm MXTPU_FAULT_INJECT for one test and reset injection counters
+    on both arm and teardown (counters are cached per env value)."""
+    from mxnet_tpu import resilience
+
+    def arm(spec):
+        monkeypatch.setenv("MXTPU_FAULT_INJECT", spec)
+        resilience.reset_faults()
+
+    yield arm
+    monkeypatch.delenv("MXTPU_FAULT_INJECT", raising=False)
+    resilience.reset_faults()
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     """Reference: @with_seed() in tests/python/unittest/common.py —

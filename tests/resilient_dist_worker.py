@@ -1,0 +1,66 @@
+"""Worker for the 2-process crash-resume fault test (test_distributed.py).
+
+Each rank trains a deterministic toy model (rank-independent SGD on a
+quadratic, so a serial replay verifies the final state), checkpoints to a
+per-rank LocalCheckpointer every 5 steps, and runs a guarded
+``distributed.barrier`` every step so the gang fate-shares.
+
+Fault script: rank 1 self-SIGTERMs at step CRASH_STEP on its FIRST life
+(a marker file in the shared work dir prevents the relaunched rank from
+re-crashing).  Rank 0's next barrier then wedges waiting on the dead
+peer; MXTPU_COLLECTIVE_TIMEOUT + MXTPU_WATCHDOG_ACTION=abort must kill
+it with a stack dump instead of letting it hang.  On relaunch both ranks
+resume from their latest checkpoint and finish.
+"""
+
+import os
+import signal
+import sys
+
+import numpy as np
+
+CRASH_STEP = 17
+
+
+def main():
+    work_dir = sys.argv[1]
+    num_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+
+    from mxnet_tpu import distributed, resilience
+
+    distributed.init_from_env()
+    rank = distributed.rank()
+    marker = os.path.join(work_dir, "crashed_once")
+    ck = resilience.LocalCheckpointer(
+        os.path.join(work_dir, f"rank{rank}"), max_to_keep=3)
+
+    state = {"w": np.full(4, 10.0)}
+
+    def set_state(s):
+        state["w"] = np.asarray(s["w"]).copy()
+
+    start = resilience.resume_latest(ck, set_state)
+    if start:
+        print(f"worker {rank}: resumed from step {start}", flush=True)
+
+    for step in range(start, num_steps):
+        if rank == 1 and step == CRASH_STEP and not os.path.exists(marker):
+            # first life only: die hard, mid-step, before the barrier —
+            # the last checkpoint (step 15) is what the relaunch resumes
+            with open(marker, "w"):
+                pass
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        distributed.barrier(f"step{step}")
+        state["w"] = state["w"] - 0.05 * 2 * state["w"]
+        if (step + 1) % 5 == 0:
+            ck.save(step + 1, {"w": state["w"]})
+
+    if ck.latest_step() != num_steps:
+        ck.save(num_steps, {"w": state["w"]})
+    print(f"worker {rank}: resilient run done at step {num_steps} "
+          f"w0={state['w'][0]:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,3 +121,10 @@ def test_example_matrix_factorization():
 def test_example_neural_style():
     out = _run("neural_style.py", "--steps", "50", timeout=500)
     assert "neural style OK" in out
+
+
+def test_example_train_resilient():
+    out = _run("train_resilient.py", "--steps", "40",
+               "--crash-step", "15")
+    assert "recovery OK" in out
+    assert "train_resilient: all checks passed" in out

@@ -617,3 +617,165 @@ def test_native_decode_beats_pil():
                            force_python=True)
     assert native >= 0.9 * pil, \
         f"native decode ({native:.0f}/s) slower than PIL ({pil:.0f}/s)"
+
+
+# -- corruption hardening (mxnet_tpu/resilience.py integration) ----------------
+
+def _write_rec(path, payloads):
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def _read_all(reader):
+    out = []
+    while True:
+        rec = reader.read()
+        if rec is None:
+            return out
+        out.append(rec)
+
+
+def test_recordio_truncated_tail_strict(tmp_path):
+    path = str(tmp_path / "trunc.rec")
+    payloads = [bytes([i]) * 40 for i in range(5)]
+    _write_rec(path, payloads)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:     # cut mid-way through the last record
+        f.write(blob[:-25])
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(4):
+        assert r.read() == payloads[i]
+    with pytest.raises(mx.MXNetError, match="truncated"):
+        r.read()
+    r.close()
+
+
+def test_recordio_truncated_tail_skip(tmp_path):
+    path = str(tmp_path / "trunc.rec")
+    payloads = [bytes([i]) * 40 for i in range(5)]
+    _write_rec(path, payloads)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:-25])
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    with pytest.warns(UserWarning, match="truncated"):
+        got = _read_all(r)
+    assert got == payloads[:4]      # every intact record, then clean EOF
+    r.close()
+
+
+def test_recordio_partial_header_tail(tmp_path):
+    path = str(tmp_path / "hdr.rec")
+    payloads = [b"x" * 16, b"y" * 16]
+    _write_rec(path, payloads)
+    with open(path, "ab") as f:     # 5 stray bytes: not even a header
+        f.write(b"\x01\x02\x03\x04\x05")
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payloads[0]
+    assert r.read() == payloads[1]
+    with pytest.raises(mx.MXNetError, match="trailing header"):
+        r.read()
+    r.close()
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    with pytest.warns(UserWarning):
+        assert _read_all(r) == payloads
+    r.close()
+
+
+def test_recordio_bad_magic_strict(tmp_path):
+    path = str(tmp_path / "magic.rec")
+    payloads = [bytes([65 + i]) * 32 for i in range(6)]
+    _write_rec(path, payloads)
+    # stomp record 2's magic (each record: 8B header + 32B payload)
+    off = 2 * (8 + 32)
+    blob = bytearray(open(path, "rb").read())
+    blob[off:off + 4] = b"\xff\xff\xff\xff"
+    open(path, "wb").write(bytes(blob))
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payloads[0]
+    assert r.read() == payloads[1]
+    with pytest.raises(mx.MXNetError, match="magic"):
+        r.read()
+    r.close()
+
+
+def test_recordio_bad_magic_resyncs(tmp_path):
+    path = str(tmp_path / "magic.rec")
+    payloads = [bytes([65 + i]) * 32 for i in range(6)]
+    _write_rec(path, payloads)
+    off = 2 * (8 + 32)
+    blob = bytearray(open(path, "rb").read())
+    blob[off:off + 4] = b"\xff\xff\xff\xff"
+    open(path, "wb").write(bytes(blob))
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    with pytest.warns(UserWarning, match="magic"):
+        got = _read_all(r)
+    # record 2 is lost (its header was stomped); 0,1 and 3.. survive
+    assert got == payloads[:2] + payloads[3:]
+    r.close()
+
+
+@pytest.mark.faults
+def test_recordio_injected_corrupt_record_strict(tmp_path, fault_inject):
+    path = str(tmp_path / "inj.rec")
+    payloads = [bytes([i]) * 24 for i in range(5)]
+    _write_rec(path, payloads)
+    fault_inject("corrupt_record:3")
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(3):
+        assert r.read() == payloads[i]
+    with pytest.raises(mx.MXNetError, match="injected corrupt record"):
+        r.read()
+    r.close()
+
+
+@pytest.mark.faults
+def test_recordio_injected_corrupt_record_skip(tmp_path, fault_inject):
+    path = str(tmp_path / "inj.rec")
+    payloads = [bytes([i]) * 24 for i in range(5)]
+    _write_rec(path, payloads)
+    fault_inject("corrupt_record:3")
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    with pytest.warns(UserWarning, match="injected"):
+        got = _read_all(r)
+    assert got == payloads[:3] + payloads[4:]   # record 3 dropped
+    r.close()
+
+
+@pytest.mark.faults
+def test_recordio_open_retries_flaky_fs(tmp_path, fault_inject,
+                                        monkeypatch):
+    monkeypatch.setenv("MXTPU_IO_RETRIES", "3")
+    monkeypatch.setenv("MXTPU_IO_BACKOFF", "0.001")
+    path = str(tmp_path / "flaky.rec")
+    _write_rec(path, [b"payload" * 4])
+    fault_inject("io_open:2")
+    r = recordio.MXRecordIO(path, "r")   # survives 2 injected failures
+    assert r.read() == b"payload" * 4
+    r.close()
+
+
+def test_recordio_missing_file_fails_fast(tmp_path):
+    t0 = __import__("time").monotonic()
+    with pytest.raises(FileNotFoundError):
+        recordio.MXRecordIO(str(tmp_path / "nope.rec"), "r")
+    assert __import__("time").monotonic() - t0 < 1.0  # ENOENT: no retry
+
+
+def test_image_record_iter_skip_corrupt_kwarg(tmp_path):
+    """ImageRecordIter(skip_corrupt=True) survives a truncated tail and
+    still yields the intact images."""
+    rec, _ = _make_rec(tmp_path, n=6, size=(8, 8))
+    blob = open(rec, "rb").read()
+    with open(rec, "wb") as f:
+        f.write(blob[:-30])
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=5,
+                                   data_shape=(3, 8, 8),
+                                   skip_corrupt=True)
+        batch = next(iter(it))
+    assert batch.data[0].shape == (5, 3, 8, 8)

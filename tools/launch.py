@@ -17,26 +17,82 @@ import argparse
 import os
 import subprocess
 import sys
+import time
+
+
+def _spawn_gang(cmd, num_workers, port):
+    """Spawn one full gang of workers sharing a rendezvous on ``port``."""
+    procs = []
+    coord = f"127.0.0.1:{port}"
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": coord,
+            "MXTPU_NUM_WORKERS": str(num_workers),
+            "MXTPU_WORKER_RANK": str(rank),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
+
+
+def _terminate_gang(procs, grace=10.0):
+    """SIGTERM every live worker, then SIGKILL stragglers after grace."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
 
 
 def launch_local(args, cmd):
     """Spawn n worker processes on localhost, each with the env
     jax.distributed expects (reference: dmlc tracker 'local' mode env
-    DMLC_ROLE/DMLC_PS_ROOT_URI → MXTPU_COORDINATOR/RANK/WORLD)."""
-    procs = []
-    coord = f"127.0.0.1:{args.port}"
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "MXTPU_COORDINATOR": coord,
-            "MXTPU_NUM_WORKERS": str(args.num_workers),
-            "MXTPU_WORKER_RANK": str(rank),
-        })
-        procs.append(subprocess.Popen(cmd, env=env))
-    code = 0
-    for p in procs:
-        code = p.wait() or code
-    return code
+    DMLC_ROLE/DMLC_PS_ROOT_URI → MXTPU_COORDINATOR/RANK/WORLD).
+
+    Gang supervision: a distributed job is all-or-nothing — one dead
+    worker wedges every surviving collective.  When any worker exits
+    nonzero the whole gang is torn down, and with ``--max-restarts N``
+    the full gang is relaunched (workers are expected to resume from
+    their latest checkpoint; see mxnet_tpu/resilience.py).  Each attempt
+    uses ``port + attempt`` so a lingering coordinator socket from the
+    dead gang can't poison the new rendezvous.
+    """
+    for attempt in range(args.max_restarts + 1):
+        procs = _spawn_gang(cmd, args.num_workers, args.port + attempt)
+        live = {p.pid: p for p in procs}
+        failed = 0
+        while live:
+            time.sleep(0.2)
+            for pid, p in list(live.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del live[pid]
+                if code != 0:
+                    failed = code
+            if failed:
+                # gang fate-sharing: survivors are wedged in collectives
+                # waiting on the dead rank — tear them down now
+                _terminate_gang(list(live.values()))
+                live.clear()
+        if not failed:
+            return 0
+        if attempt < args.max_restarts:
+            sys.stderr.write(
+                f"[launch] worker exited rc={failed}; restarting gang "
+                f"(attempt {attempt + 2}/{args.max_restarts + 1}, "
+                f"port {args.port + attempt + 1})\n")
+    return failed
 
 
 def launch_ssh(args, cmd):
@@ -66,6 +122,10 @@ def main():
                         default="local")
     parser.add_argument("--hostfile", default=None)
     parser.add_argument("--port", type=int, default=9927)
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="relaunch the full gang up to N times after "
+                             "a nonzero worker exit (local launcher); "
+                             "workers resume from their checkpoints")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     cmd = args.command
