@@ -166,6 +166,19 @@ def _bert_attempts(tpu_ok):
     ]
 
 
+def _trainer_attempts(tpu_ok):
+    steps = int(os.environ.get("BENCH_TRAINER_STEPS", 30))
+    nparams = int(os.environ.get("BENCH_TRAINER_PARAMS", 160))
+    cfg = {"model": "trainer_step", "params": nparams, "batch": nparams,
+           "steps": steps}
+    attempts = []
+    if tpu_ok:
+        attempts.append((None, dict(cfg, backend="tpu"), 240))
+    attempts.append(({"JAX_PLATFORMS": "cpu"},
+                     dict(cfg, backend="cpu"), 240))
+    return attempts
+
+
 def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
@@ -230,6 +243,14 @@ def orchestrate():
                                bert_timed_out)
             if bert is not None:
                 break
+    trainer_bench = None
+    trainer_errors = []
+    if headline is not None and not os.environ.get("BENCH_SKIP_TRAINER"):
+        for env_over, cfg, budget in _trainer_attempts(tpu_ok):
+            trainer_bench = _run_worker(env_over, cfg, budget,
+                                        trainer_errors)
+            if trainer_bench is not None:
+                break
     if headline is None:
         print(json.dumps({
             "metric": "resnet50_train_samples_per_sec_per_chip",
@@ -249,6 +270,13 @@ def orchestrate():
         headline["bert_scan_layers"] = bert.get("scan_layers")
     elif bert_errors:
         headline["bert_error"] = "; ".join(bert_errors)[-300:]
+    if trainer_bench is not None:
+        headline["trainer_step_us"] = trainer_bench["value"]
+        headline["trainer_step_us_legacy"] = trainer_bench.get("legacy_us")
+        headline["trainer_step_speedup"] = trainer_bench.get("speedup")
+        headline["trainer_step_params"] = trainer_bench.get("params")
+    elif trainer_errors:
+        headline["trainer_error"] = "; ".join(trainer_errors)[-300:]
     print(json.dumps(headline))
     return 0
 
@@ -382,6 +410,8 @@ def worker(cfg):
 
     if cfg["model"] == "bert":
         bench_bert(cfg, devices)
+    elif cfg["model"] == "trainer_step":
+        bench_trainer(cfg, devices)
     else:
         bench_resnet(cfg, devices)
 
@@ -447,6 +477,71 @@ def bench_resnet(cfg, devices):
         "batch": batch_size,
         "image": cfg["image"],
         "layout": layout,
+    }))
+
+
+def bench_trainer(cfg, devices):
+    """trainer_step_us: imperative Gluon Trainer optimizer-step latency on
+    a many-small-parameter model (~cfg['params'] tensors).  The metric is
+    DISPATCH overhead — one jitted multi-tensor program per (optimizer,
+    dtype) group vs the legacy one-eager-op-chain-per-parameter loop — so
+    tensors are tiny on purpose.  Both paths are timed warm (post-compile)
+    with readback-terminated loops."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    n_params, steps = cfg["params"], cfg["steps"]
+    n_layers = max(1, n_params // 2)  # Dense = weight + bias
+
+    net = nn.HybridSequential(prefix="bench_")
+    with net.name_scope():
+        for _ in range(n_layers):
+            net.add(nn.Dense(32, in_units=32, flatten=False))
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+
+    x = mx.nd.array(np.random.RandomState(0)
+                    .standard_normal((8, 32)).astype("float32"))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    first = list(net.collect_params().values())[0]
+
+    def step():
+        trainer.step(8, ignore_stale_grad=True)
+        return first.data()
+
+    _readback(step())
+    _readback(step())
+    dt, _ = _timed_loop(step, steps)
+    fused_us = dt / steps * 1e6
+
+    # legacy per-parameter loop, same process (the flag is read per step)
+    os.environ["MXTPU_FUSED_STEP"] = "0"
+    try:
+        _readback(step())
+        legacy_steps = max(3, steps // 5)
+        dt2, _ = _timed_loop(step, legacy_steps)
+        legacy_us = dt2 / legacy_steps * 1e6
+    finally:
+        os.environ.pop("MXTPU_FUSED_STEP", None)
+
+    actual = sum(1 for p in net.collect_params().values()
+                 if p.grad_req != "null")
+    print(json.dumps({
+        "metric": "trainer_step_us",
+        "value": round(fused_us, 1),
+        "unit": "us/step",
+        "vs_baseline": None,
+        "legacy_us": round(legacy_us, 1),
+        "speedup": round(legacy_us / fused_us, 2) if fused_us else None,
+        "params": actual,
+        "batch": n_params,
+        "backend": devices[0].platform,
     }))
 
 

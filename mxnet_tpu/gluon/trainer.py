@@ -62,6 +62,10 @@ class Trainer:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
         self._updaters = [opt.get_updater(self._optimizer)]
+        # multi-tensor path: shares each Updater's state dict, so
+        # save/load_states round-trip regardless of which path stepped
+        self._grouped_updaters = [opt.GroupedUpdater(u)
+                                  for u in self._updaters]
 
     def _init_kvstore(self):
         config = self._kvstore_params
@@ -124,15 +128,23 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param._grad_req != "null":
-                if self._update_on_kvstore:
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param._grad_req != "null":
                     # push grad; pull updated weight (server-side optimizer)
                     self._kvstore.push(i, param.list_grad(), priority=-i)
-                else:
-                    self._kvstore.pushpull(i, param.list_grad(),
-                                           out=param.list_grad(),
-                                           priority=-i)
+            return
+        keys = [i for i, param in enumerate(self._params)
+                if param._grad_req != "null"]
+        if opt.grouped.fused_step_enabled() \
+                and hasattr(self._kvstore, "bucketed_pushpull"):
+            grads = [self._params[i].list_grad() for i in keys]
+            self._kvstore.bucketed_pushpull(keys, grads, outs=grads)
+            return
+        for i in keys:
+            self._kvstore.pushpull(i, self._params[i].list_grad(),
+                                   out=self._params[i].list_grad(),
+                                   priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -145,6 +157,7 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        updates = []
         for i, param in enumerate(self._params):
             if param._grad_req == "null":
                 continue
@@ -157,7 +170,16 @@ class Trainer:
             if self._update_on_kvstore:
                 self._kvstore.pull(i, param.list_data(), priority=-i)
             else:
-                self._updaters[0](i, param.grad(), param.data())
+                updates.append((i, param.grad(), param.data()))
+        if not updates:
+            return
+        indices, grads, weights = map(list, zip(*updates))
+        if opt.grouped.fused_step_enabled():
+            # one jitted dispatch per (kernel, hyper-params, dtype) group
+            self._grouped_updaters[0](indices, grads, weights)
+        else:
+            for i, g, w in updates:
+                self._updaters[0](i, g, w)
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference: Trainer.save_states)."""
@@ -182,8 +204,41 @@ class Trainer:
                 states = f.read()
             self._updaters[0].set_states(states)
             self._updaters[0].optimizer = self._optimizer
+            self._validate_updater_states(fname)
         self._optimizer.param_dict = {
             i: param for i, param in enumerate(self._params)}
+
+    def _validate_updater_states(self, fname):
+        """Loaded states are keyed by parameter INDEX; if the param list
+        changed (count or shapes) since save, applying them would silently
+        step the wrong arrays — fail loudly instead."""
+
+        def _leaves(state):
+            if state is None:
+                return []
+            if isinstance(state, (list, tuple)):
+                return [a for s in state for a in _leaves(s)]
+            return [state] if isinstance(state, NDArray) else []
+
+        states = self._updaters[0].states
+        nparams = len(self._params)
+        for idx, state in states.items():
+            if not isinstance(idx, int) or idx < 0 or idx >= nparams:
+                raise MXNetError(
+                    f"Trainer.load_states: '{fname}' holds optimizer state "
+                    f"for parameter index {idx!r}, but this trainer has "
+                    f"only {nparams} parameters. The parameter list "
+                    "changed since the states were saved.")
+            param = self._params[idx]
+            pshape = tuple(param.shape) if param.shape else None
+            for arr in _leaves(state):
+                if pshape is not None and tuple(arr.shape) != pshape:
+                    raise MXNetError(
+                        f"Trainer.load_states: state shape "
+                        f"{tuple(arr.shape)} for parameter index {idx} "
+                        f"('{param.name}') does not match the parameter "
+                        f"shape {pshape}. The parameter list changed "
+                        "since the states were saved.")
 
 
 def kvstore_requires_store(kv):

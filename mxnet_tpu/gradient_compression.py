@@ -30,6 +30,14 @@ _SHIFTS = (0, 2, 4, 6)
 class GradientCompression:
     """Per-KVStore compression state: type, threshold, per-key residuals."""
 
+    # Bucketed all-reduce (KVStore.bucketed_pushpull) concatenates many
+    # keys into one flat buffer, but every mode here keeps a PER-KEY
+    # error-feedback residual whose shape is the key's own — compressing
+    # a bucket would silently merge residuals across keys.  KVStore
+    # therefore drops to the per-key pushpull path whenever compression
+    # is active.
+    supports_bucketing = False
+
     def __init__(self, params):
         params = dict(params or {})
         self.type = params.pop("type", "2bit")

@@ -16,16 +16,28 @@ semantics have no TPU analog and run synchronously (documented drop).
 
 from __future__ import annotations
 
+import os
 import pickle
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, _from_jax
 from . import optimizer as opt
+from . import profiler
 from . import resilience
 
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _bucket_bytes():
+    """Flat-bucket byte budget for `bucketed_pushpull`
+    (MXTPU_ALLREDUCE_BUCKET_MB, default 4 MB)."""
+    try:
+        mb = float(os.environ.get("MXTPU_ALLREDUCE_BUCKET_MB", "4"))
+    except ValueError:
+        mb = 4.0
+    return max(1, int(mb * 1024 * 1024))
 
 
 _ALLREDUCE_CACHE = {}
@@ -209,7 +221,13 @@ class KVStore:
             if k in self._store:
                 raise MXNetError(f"key {k} already initialized")
             vs = _as_list(v)
-            self._store[k] = vs[0].copy()
+            v0 = vs[0]
+            if type(v0) is NDArray:
+                # own the buffer: the caller's array may later be DONATED
+                # by the fused update path, which would delete a shared one
+                self._store[k] = _from_jax(v0._data.copy())
+            else:
+                self._store[k] = v0.copy()
 
     def _normalize(self, key, value):
         if isinstance(key, (list, tuple)):
@@ -277,6 +295,83 @@ class KVStore:
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
+
+    def bucketed_pushpull(self, keys, values, outs=None, priority=0):
+        """Bucketed all-reduce: dense values are flattened and
+        concatenated into ~MXTPU_ALLREDUCE_BUCKET_MB (default 4 MB) flat
+        buckets per dtype, reduced with ONE collective per bucket, and
+        split back — the reference's big-array batching
+        (MXNET_KVSTORE_BIGARRAY_BOUND / NCCL coalescing) turned inside
+        out for per-parameter gradient lists.
+
+        Keys that bucketing cannot express fall back to per-key
+        `pushpull`: row-sparse values, any active gradient compression
+        (its error-feedback residuals are per-key), and server-side
+        updaters (the update consumes each key's reduction separately).
+        """
+        from .ndarray.sparse import RowSparseNDArray
+
+        if outs is None:
+            outs = [None] * len(keys)
+        gc = self._compression
+        if self._updater is not None or \
+                (gc is not None and not getattr(gc, "supports_bucketing",
+                                                False)):
+            for k, v, o in zip(keys, values, outs):
+                self.pushpull(k, v, out=o, priority=priority)
+            return
+        import jax.numpy as jnp
+
+        # local device-list merge per key (the reference's Comm tree),
+        # splitting off anything non-bucketable
+        dense = []  # (key, merged_raw, out)
+        for k, v, o in zip(keys, values, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            vals = _as_list(v)
+            if any(isinstance(x, RowSparseNDArray) for x in vals) or \
+                    isinstance(self._store[k], RowSparseNDArray):
+                self.pushpull(k, v, out=o, priority=priority)
+                continue
+            merged = vals[0]
+            for x in vals[1:]:
+                merged = merged + x
+            raw = merged._data if isinstance(merged, NDArray) else merged
+            dense.append((k, raw, o))
+        if not dense:
+            return
+        # greedy per-dtype fill up to the bucket byte budget
+        budget = _bucket_bytes()
+        buckets = []
+        fill = {}
+        for item in dense:
+            raw = item[1]
+            nbytes = raw.size * raw.dtype.itemsize
+            dt = str(raw.dtype)
+            cur = fill.get(dt)
+            if cur is None or cur[1] + nbytes > budget:
+                cur = [[], 0]
+                buckets.append((dt, cur))
+                fill[dt] = cur
+            cur[0].append(item)
+            cur[1] += nbytes
+        multi = self._is_dist and self.num_workers > 1
+        for _dt, (items, _n) in buckets:
+            with profiler.annotate("bucket_pack"):
+                flat = jnp.concatenate(
+                    [raw.reshape(-1) for _, raw, _ in items]) \
+                    if len(items) > 1 else items[0][1].reshape(-1)
+            if multi:
+                with profiler.annotate("allreduce"):
+                    flat = _cross_process_allreduce(flat)
+            offset = 0
+            for k, raw, o in items:
+                piece = flat[offset:offset + raw.size].reshape(raw.shape)
+                offset += raw.size
+                self._store[k]._set_data(piece)
+                if o is not None:
+                    for dst in _as_list(o):
+                        dst._set_data(piece)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull ONLY the requested rows as compact row-sparse arrays —

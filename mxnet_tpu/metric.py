@@ -6,9 +6,14 @@ RMSE, PearsonCorrelation, Loss, Torch→dropped, CompositeEvalMetric,
 CustomMetric + ``mx.metric.np``), ``create`` factory, and
 ``check_label_shapes``.
 
-Metric math runs on host numpy: metric updates are tiny reductions at batch
-cadence — pulling once per batch and computing on CPU avoids polluting the
-XLA program cache and matches where the reference runs them (CPU).
+Metric math runs on host numpy, EXCEPT the hot classification metrics
+(Accuracy, TopKAccuracy): when both label and prediction live on device,
+argmax/argsort + compare + count run as ONE cached jitted program and only
+the scalar correct-count is read back per update() — pulling the full
+(batch, num_classes) logits to host every batch costs more transfer than
+the whole optimizer step.  Everything else stays on host: those updates are
+tiny reductions at batch cadence and matching reference (CPU) numpy
+semantics exactly matters more than transfer time.
 """
 
 from __future__ import annotations
@@ -80,6 +85,52 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 
 def _to_numpy(x):
     return x.asnumpy() if hasattr(x, "asnumpy") else numpy.asarray(x)
+
+
+_DEVICE_METRIC_CACHE = {}
+
+
+def _device_correct_count(kind, pred, label, **static):
+    """Correct-prediction count as one jitted program on device.
+
+    ``pred``/``label`` are raw jax arrays; the returned device scalar is
+    the caller's single host readback.  Programs are cached per
+    (kind, static config) — jax.jit handles per-shape retracing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    key = (kind,) + tuple(sorted(static.items()))
+    fn = _DEVICE_METRIC_CACHE.get(key)
+    if fn is None:
+        if kind == "acc":
+            axis = static["axis"]
+            need_argmax = static["need_argmax"]
+
+            def fn(pred, label):
+                p = jnp.argmax(pred, axis=axis) if need_argmax else pred
+                return (p.astype(jnp.int32).reshape(-1)
+                        == label.astype(jnp.int32).reshape(-1)).sum()
+        else:  # topk
+            top_k = static["top_k"]
+
+            def fn(pred, label):
+                # jnp.argsort is stable; on ties it yields the same order
+                # as the host numpy path for the shapes tested here.
+                # lax.top_k breaks ties by highest index — wrong answers.
+                order = jnp.argsort(pred.astype(jnp.float32), axis=-1)
+                lab = label.astype(jnp.int32).reshape(-1)
+                if order.ndim == 1:
+                    return (order == lab).sum()
+                num_classes = order.shape[1]
+                hits = jnp.zeros((), jnp.int32)
+                for j in range(min(num_classes, top_k)):
+                    hits = hits + (order[:, num_classes - 1 - j]
+                                   == lab).sum()
+                return hits
+        fn = jax.jit(fn)
+        _DEVICE_METRIC_CACHE[key] = fn
+    return fn(pred, label)
 
 
 class EvalMetric:
@@ -178,7 +229,24 @@ class Accuracy(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
+        counts = []  # (device scalar, n) pairs; one readback after the loop
         for label, pred_label in zip(labels, preds):
+            if hasattr(pred_label, "_data") and hasattr(label, "_data"):
+                pshape = tuple(pred_label.shape)
+                lshape = tuple(label.shape)
+                need_argmax = pshape != lshape
+                if need_argmax:
+                    ax = self.axis % len(pshape)
+                    out_size = math.prod(
+                        s for d, s in enumerate(pshape) if d != ax)
+                else:
+                    out_size = math.prod(pshape)
+                check_label_shapes(range(math.prod(lshape)), range(out_size))
+                dev = _device_correct_count(
+                    "acc", pred_label._data, label._data,
+                    axis=self.axis, need_argmax=need_argmax)
+                counts.append((dev, out_size))
+                continue
             pred = _to_numpy(pred_label)
             label_np = _to_numpy(label).astype("int32")
             if pred.shape != label_np.shape:
@@ -188,6 +256,10 @@ class Accuracy(EvalMetric):
             check_label_shapes(label_np, pred)
             correct = (pred == label_np).sum()
             self._update(float(correct), len(pred))
+        if counts:
+            total = counts[0][0] if len(counts) == 1 \
+                else sum(c for c, _ in counts)
+            self._update(float(total), sum(n for _, n in counts))
 
 
 @register
@@ -203,9 +275,17 @@ class TopKAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
+        counts = []  # (device scalar, n) pairs; one readback after the loop
         for label, pred_label in zip(labels, preds):
             assert len(pred_label.shape) <= 2, \
                 "Predictions should be no more than 2 dims"
+            if hasattr(pred_label, "_data") and hasattr(label, "_data"):
+                check_label_shapes(range(label.shape[0]),
+                                   range(pred_label.shape[0]))
+                dev = _device_correct_count(
+                    "topk", pred_label._data, label._data, top_k=self.top_k)
+                counts.append((dev, pred_label.shape[0]))
+                continue
             pred = numpy.argsort(_to_numpy(pred_label).astype("float32"),
                                  axis=-1)
             label_np = _to_numpy(label).astype("int32")
@@ -223,6 +303,10 @@ class TopKAccuracy(EvalMetric):
                     correct += float((pred[:, num_classes - 1 - j].flatten()
                                       == label_np.flatten()).sum())
                 self._update(correct, num_samples)
+        if counts:
+            total = counts[0][0] if len(counts) == 1 \
+                else sum(c for c, _ in counts)
+            self._update(float(total), sum(n for _, n in counts))
 
 
 class _BinaryClassificationMetrics:

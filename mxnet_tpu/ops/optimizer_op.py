@@ -188,6 +188,50 @@ def ftml_update_pure(weight, grad, d, v, z, lr, t=1, beta1=0.6,
     return -z / d_t, d_t, v, z
 
 
+def lamb_fused_update_pure(weight, grad, mean, var, lr, wd, denom1, denom2,
+                           beta1=0.9, beta2=0.999, epsilon=1e-6,
+                           rescale_grad=1.0, clip_gradient=-1.0,
+                           lower_bound=-1.0, upper_bound=-1.0):
+    """Single-dispatch LAMB step for the grouped Trainer path: phase1 +
+    trust-ratio norms + phase2 in one program.  ``denom1``/``denom2``
+    are the HOST-precomputed bias-correction denominators
+    ``1 - beta**t`` so the step count is a traced scalar and never
+    retraces; with ``bias_correction=False`` pass 1.0 — ``x / 1.0`` is
+    an IEEE identity, keeping bitwise parity with phase1's uncorrected
+    branch."""
+    grad = _rescale(grad, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1.0 - beta1) * grad
+    var = beta2 * var + (1.0 - beta2) * jnp.square(grad)
+    mhat = mean / denom1
+    vhat = var / denom2
+    g_new = mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight
+    r1 = jnp.linalg.norm(weight)
+    r2 = jnp.linalg.norm(g_new)
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g_new, mean, var
+
+
+def ftml_fused_update_pure(weight, grad, d, v, z, c_over_lr, coef2, wd,
+                           beta1=0.6, beta2=0.999, epsilon=1e-8,
+                           rescale_grad=1.0, clip_grad=-1.0):
+    """FTML step for the grouped Trainer path.  The step-count terms are
+    host-precomputed exactly as ``ftml_update_pure`` applies them —
+    ``c_over_lr = (1 - beta1**t) / lr`` and ``coef2 = 1 - beta2**t`` —
+    so ``t`` never appears as a trace-shaping value."""
+    grad = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        grad = jnp.clip(grad, -clip_grad, clip_grad)
+    v = beta2 * v + (1.0 - beta2) * jnp.square(grad)
+    d_t = c_over_lr * (jnp.sqrt(v / coef2) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1.0 - beta1) * grad - sigma * weight
+    return -z / d_t, d_t, v, z
+
+
 def lamb_update_phase1_pure(weight, grad, mean, var, t=1, beta1=0.9,
                             beta2=0.999, epsilon=1e-6, wd=0.0,
                             bias_correction=True, rescale_grad=1.0,
@@ -286,6 +330,62 @@ for _name, _fn in [
     ("mp_lamb_update_phase1", mp_lamb_update_phase1_pure),
 ]:
     _register_update(_name, _fn)
+
+
+# -- single-parameter jitted dispatch ------------------------------------------
+#
+# The per-parameter Updater path compiles each update into ONE cached XLA
+# program instead of dispatching op-by-op.  Per-step host scalars (lr/wd/
+# rescale_grad) enter as traced arguments cast to the weight dtype, so LR
+# schedules never retrace; every other kwarg is a Python constant baked
+# into the trace.  Keeping the same trace structure as the grouped
+# multi-tensor path (optimizer/grouped.py) makes the two bitwise-equal:
+# XLA's FMA contraction applies identically to both programs, where the
+# old op-by-op eager sequence rounded every intermediate.
+
+_DYN_ARGS = {
+    "adadelta_update_pure": ("wd", "rescale_grad"),
+    # t/lr fold into trace-time f64 constants exactly as the eager host
+    # code computed them (retraces per step — fallback path only)
+    "ftml_update_pure": ("wd", "rescale_grad"),
+    "lamb_update_phase1_pure": ("wd", "rescale_grad"),
+    "lamb_update_phase2_pure": ("lr",),
+    "lamb_fused_update_pure": ("lr", "wd", "rescale_grad", "denom1",
+                               "denom2"),
+    "ftml_fused_update_pure": ("c_over_lr", "coef2", "wd", "rescale_grad"),
+}
+_DEFAULT_DYN = ("lr", "wd", "rescale_grad")
+
+_SINGLE_CACHE = {}
+
+
+def fused_dispatch(pure_fn, weight, grad, states, kwargs):
+    """Run ``pure_fn(weight, grad, *states, **kwargs)`` as one cached
+    jitted program (weight and states donated).  Raw jax arrays in, raw
+    results out."""
+    import numpy as _np
+
+    import jax
+
+    dyn_names = tuple(
+        n for n in _DYN_ARGS.get(pure_fn.__name__, _DEFAULT_DYN)
+        if n in kwargs)
+    static_items = tuple(sorted(
+        (k, v) for k, v in kwargs.items() if k not in dyn_names))
+    key = (pure_fn, dyn_names, static_items)
+    fn = _SINGLE_CACHE.get(key)
+    if fn is None:
+        static = dict(static_items)
+
+        def one(w, g, ss, dyn):
+            kw = dict(static)
+            kw.update(dyn)
+            return pure_fn(w, g, *ss, **kw)
+
+        fn = jax.jit(one, donate_argnums=(0, 2))
+        _SINGLE_CACHE[key] = fn
+    dyn = {n: _np.asarray(kwargs[n], weight.dtype) for n in dyn_names}
+    return fn(weight, grad, list(states), dyn)
 
 
 PURE_UPDATES = {

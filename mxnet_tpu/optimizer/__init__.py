@@ -8,6 +8,8 @@ from .optimizer import (Optimizer, SGD, NAG, Adam, Adamax, Nadam, RMSProp,
                         register, create)
 from .lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
                            PolyScheduler, CosineScheduler)
+from . import grouped
+from .grouped import GroupedUpdater
 
 # reference alias: mx.optimizer.ccSGD etc. are deprecated; keep `create`
 # as the canonical factory (mx.optimizer.create / Optimizer.create_optimizer)

@@ -1,0 +1,392 @@
+"""Grouped (multi-tensor) optimizer stepping for the imperative Trainer.
+
+Reference parity: the `multi_sgd_update` / `multi_mp_sgd_update` /
+`multi_lamb` family (src/operator/optimizer_op.cc ≥1.6) plus Gluon's
+`Trainer` aggregation (`MXNET_OPTIMIZER_AGGREGATION_SIZE`): instead of one
+kernel launch per parameter, whole groups of parameters step in a single
+fused call.
+
+TPU-first design: `GroupedUpdater` partitions a Trainer's parameters into
+groups keyed by (update kernel, static hyper-params, dtype) and applies
+each group in ONE cached `jax.jit` program — pytrees of weights, grads and
+states in, pytrees out, with weights and states donated so XLA updates
+in place.  Per-step scalars (lr, wd, rescale_grad and the host-folded
+step-count coefficients) enter as traced f32/f16 scalars cast to the
+group dtype on the host, which keeps LR schedules from retracing AND
+keeps the arithmetic bitwise-identical to the eager per-parameter loop
+(a Python float in eager mode is weakly typed and rounds to the array
+dtype in one step — exactly what the host-side cast does).
+
+Anything the grouped kernels cannot express bitwise-identically — the
+inline-eager optimizers (Nadam, Adamax, DCASGD, SGLD, Test), row-sparse
+gradients, multi-precision fp16 master weights — falls back to the legacy
+`Updater` per-parameter path, so numerics never change silently.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import warnings
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from ..ops import optimizer_op as _op
+from . import optimizer as _optmod
+
+# CPU/older backends cannot honor buffer donation; jax warns per call.
+# The fallback (a copy) is correct, so the warning is pure noise here.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def fused_step_enabled() -> bool:
+    """MXTPU_FUSED_STEP gate (default on); 0/false/off restores the
+    legacy per-parameter loop."""
+    return os.environ.get("MXTPU_FUSED_STEP", "1").lower() \
+        not in ("0", "false", "off", "")
+
+
+# -- dispatch accounting (regression-tested: one jit call per group/step) ------
+
+_DISPATCH_COUNT = 0
+
+
+def dispatch_count() -> int:
+    """Number of grouped optimizer-update XLA dispatches since the last
+    reset — exactly one per (kernel, static hyper-params, dtype) group
+    per step."""
+    return _DISPATCH_COUNT
+
+
+def reset_dispatch_count() -> None:
+    global _DISPATCH_COUNT
+    _DISPATCH_COUNT = 0
+
+
+# -- per-optimizer grouping plans ----------------------------------------------
+#
+# A plan maps one (optimizer, index, weight, state) item to
+# (kernel, static_kwargs, state_ndarrays, dyn_fn).  `static_kwargs` are
+# Python constants baked into the trace (identical to the eager call's
+# keyword constants); `dyn_fn(opt, index)` runs AFTER the update count is
+# bumped and returns the per-step host scalars, matching the exact float64
+# expressions the eager optimizers compute before entering their kernels.
+
+
+def _cg(opt):
+    # pure kernels treat clip_gradient<0 as "no clipping", same as the
+    # eager path omitting the kwarg
+    return -1.0 if opt.clip_gradient is None else float(opt.clip_gradient)
+
+
+def _dyn_lrwd(opt, index):
+    return {"lr": opt._get_lr(index), "wd": opt._get_wd(index),
+            "rescale_grad": opt.rescale_grad}
+
+
+def _dyn_wd(opt, index):
+    return {"wd": opt._get_wd(index), "rescale_grad": opt.rescale_grad}
+
+
+def _dyn_adam(opt, index):
+    d = _dyn_lrwd(opt, index)
+    t = opt._index_update_count[index]
+    coef1 = 1.0 - opt.beta1 ** t
+    coef2 = 1.0 - opt.beta2 ** t
+    d["lr"] = d["lr"] * (math.sqrt(coef2) / coef1)
+    return d
+
+
+def _dyn_lamb(opt, index):
+    d = _dyn_lrwd(opt, index)
+    t = opt._index_update_count[index]
+    if opt.bias_correction:
+        d["denom1"] = 1.0 - opt.beta1 ** t
+        d["denom2"] = 1.0 - opt.beta2 ** t
+    else:
+        # x / 1.0 is an IEEE identity → bitwise-equal to the
+        # uncorrected eager branch
+        d["denom1"] = 1.0
+        d["denom2"] = 1.0
+    return d
+
+
+def _dyn_ftml(opt, index):
+    lr = opt._get_lr(index)
+    t = opt._index_update_count[index]
+    return {"c_over_lr": (1.0 - opt.beta1 ** t) / lr,
+            "coef2": 1.0 - opt.beta2 ** t,
+            "wd": opt._get_wd(index),
+            "rescale_grad": opt.rescale_grad}
+
+
+def _plan_sgd(o, i, w, state):
+    if state is not None:
+        return (_op.sgd_mom_update_pure,
+                {"momentum": o.momentum, "clip_gradient": _cg(o)},
+                [state], _dyn_lrwd)
+    return (_op.sgd_update_pure, {"clip_gradient": _cg(o)}, [], _dyn_lrwd)
+
+
+def _plan_nag(o, i, w, state):
+    if state is not None:
+        return (_op.nag_mom_update_pure,
+                {"momentum": o.momentum, "clip_gradient": _cg(o)},
+                [state], _dyn_lrwd)
+    return (_op.sgd_update_pure, {"clip_gradient": _cg(o)}, [], _dyn_lrwd)
+
+
+def _plan_adam(o, i, w, state):
+    return (_op.adam_update_pure,
+            {"beta1": o.beta1, "beta2": o.beta2, "epsilon": o.epsilon,
+             "clip_gradient": _cg(o)},
+            list(state), _dyn_adam)
+
+
+def _plan_adamw(o, i, w, state):
+    return (_op.adamw_update_pure,
+            {"beta1": o.beta1, "beta2": o.beta2, "epsilon": o.epsilon,
+             "clip_gradient": _cg(o)},
+            list(state), _dyn_adam)
+
+
+def _plan_rmsprop(o, i, w, state):
+    cw = float(o.clip_weights) if o.clip_weights else -1.0
+    if o.centered:
+        return (_op.rmspropalex_update_pure,
+                {"gamma1": o.gamma1, "gamma2": o.gamma2,
+                 "epsilon": o.epsilon, "clip_gradient": _cg(o),
+                 "clip_weights": cw},
+                list(state), _dyn_lrwd)
+    return (_op.rmsprop_update_pure,
+            {"gamma1": o.gamma1, "epsilon": o.epsilon,
+             "clip_gradient": _cg(o), "clip_weights": cw},
+            list(state), _dyn_lrwd)
+
+
+def _plan_adagrad(o, i, w, state):
+    return (_op.adagrad_update_pure,
+            {"epsilon": o.float_stable_eps, "clip_gradient": _cg(o)},
+            [state], _dyn_lrwd)
+
+
+def _plan_adadelta(o, i, w, state):
+    return (_op.adadelta_update_pure,
+            {"rho": o.rho, "epsilon": o.epsilon, "clip_gradient": _cg(o)},
+            list(state), _dyn_wd)
+
+
+def _plan_ftrl(o, i, w, state):
+    return (_op.ftrl_update_pure,
+            {"lamda1": o.lamda1, "beta": o.beta, "clip_gradient": _cg(o)},
+            list(state), _dyn_lrwd)
+
+
+def _plan_signum(o, i, w, state):
+    if state is not None:
+        return (_op.signum_update_pure,
+                {"momentum": o.momentum, "wd_lh": o.wd_lh,
+                 "clip_gradient": _cg(o)},
+                [state], _dyn_lrwd)
+    return (_op.signsgd_update_pure, {"clip_gradient": _cg(o)}, [],
+            _dyn_lrwd)
+
+
+def _plan_lamb(o, i, w, state):
+    lb = -1.0 if o.lower_bound is None else float(o.lower_bound)
+    ub = -1.0 if o.upper_bound is None else float(o.upper_bound)
+    return (_op.lamb_fused_update_pure,
+            {"beta1": o.beta1, "beta2": o.beta2, "epsilon": o.epsilon,
+             "clip_gradient": _cg(o), "lower_bound": lb, "upper_bound": ub},
+            list(state), _dyn_lamb)
+
+
+def _plan_lars(o, i, w, state):
+    # 1-D params (biases, norm scales) take the plain momentum step —
+    # the optimizer's own skip list
+    if len(w.shape) <= 1:
+        return (_op.sgd_mom_update_pure,
+                {"momentum": o.momentum, "clip_gradient": _cg(o)},
+                [state], _dyn_lrwd)
+    return (_op.lars_update_pure,
+            {"momentum": o.momentum, "eta": o.eta, "epsilon": o.epsilon,
+             "clip_gradient": _cg(o)},
+            [state], _dyn_lrwd)
+
+
+def _plan_ftml(o, i, w, state):
+    return (_op.ftml_fused_update_pure,
+            {"beta1": o.beta1, "beta2": o.beta2, "epsilon": o.epsilon,
+             "clip_grad": _cg(o)},
+            list(state), _dyn_ftml)
+
+
+# exact-type dispatch: a user SUBCLASS of a registered optimizer may
+# override update() arbitrarily, so it must take the legacy loop
+_PLANS = {
+    _optmod.SGD: _plan_sgd,
+    _optmod.NAG: _plan_nag,
+    _optmod.Adam: _plan_adam,
+    _optmod.AdamW: _plan_adamw,
+    _optmod.RMSProp: _plan_rmsprop,
+    _optmod.AdaGrad: _plan_adagrad,
+    _optmod.AdaDelta: _plan_adadelta,
+    _optmod.Ftrl: _plan_ftrl,
+    _optmod.Signum: _plan_signum,
+    _optmod.LAMB: _plan_lamb,
+    _optmod.LARS: _plan_lars,
+    # LBSGD only overrides the HOST-side lr warmup (_get_lr), which the
+    # dyn scalars already route through — device math is LARS's
+    _optmod.LBSGD: _plan_lars,
+    _optmod.FTML: _plan_ftml,
+}
+
+
+def _groupable(opt, weight, grad):
+    """Items the grouped kernels reproduce bitwise; everything else
+    falls back to the per-parameter Updater."""
+    from ..ndarray.sparse import RowSparseNDArray
+
+    if isinstance(grad, RowSparseNDArray) \
+            or isinstance(weight, RowSparseNDArray):
+        return False
+    w_raw = weight._data if isinstance(weight, NDArray) else weight
+    g_raw = grad._data if isinstance(grad, NDArray) else grad
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(w_raw.dtype, jnp.floating):
+        return False
+    if w_raw.dtype != g_raw.dtype:
+        return False
+    if opt.multi_precision and w_raw.dtype == _np.float16:
+        return False
+    return True
+
+
+# -- the jitted group program --------------------------------------------------
+
+_GROUP_FN_CACHE = {}
+
+
+def _group_fn(kernel, static_items):
+    """One cached jit program per (kernel, static hyper-params).  Inside
+    the trace the per-item kernels unroll into a single XLA module;
+    weights (arg 0) and states (arg 2) are donated so the update is
+    in-place on backends that support donation."""
+    key = (kernel, static_items)
+    fn = _GROUP_FN_CACHE.get(key)
+    if fn is None:
+        import jax
+
+        static = dict(static_items)
+
+        def group_step(weights, grads, states, dyn):
+            new_w, new_s = [], []
+            for j in range(len(weights)):
+                kw = dict(static)
+                for name, col in dyn.items():
+                    kw[name] = col[j]
+                res = kernel(weights[j], grads[j], *states[j], **kw)
+                new_w.append(res[0])
+                new_s.append(list(res[1:]))
+            return new_w, new_s
+
+        fn = jax.jit(group_step, donate_argnums=(0, 2))
+        _GROUP_FN_CACHE[key] = fn
+    return fn
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class GroupedUpdater:
+    """Multi-tensor drop-in for `Updater` on the Trainer's local path.
+
+    Shares the wrapped Updater's `states` dict (and creates states through
+    the same `create_state_multi_precision` call), so `save_states` /
+    `load_states` and `set_states` round-trip identically whichever path
+    ran the steps.
+    """
+
+    def __init__(self, updater):
+        self._updater = updater
+
+    @property
+    def optimizer(self):
+        return self._updater.optimizer
+
+    @property
+    def states(self):
+        return self._updater.states
+
+    def __call__(self, index, grad, weight):
+        from .. import profiler
+
+        upd = self._updater
+        o = upd.optimizer
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        plan = _PLANS.get(type(o))
+        groups = {}
+        fallback = []
+        for i, g, w in zip(index, grad, weight):
+            if i not in upd.states:
+                upd.states[i] = o.create_state_multi_precision(i, w)
+                upd.states_synced[i] = True
+            item = None
+            if plan is not None and _groupable(o, w, g):
+                item = plan(o, i, w, upd.states[i])
+            if item is None:
+                fallback.append((i, g, w))
+                continue
+            kernel, static, state_nds, dyn_fn = item
+            static_items = tuple(sorted(static.items()))
+            gkey = (kernel, static_items, str(_raw(w).dtype))
+            groups.setdefault(gkey, []).append((i, w, g, state_nds, dyn_fn))
+        # legacy per-parameter loop for whatever the kernels can't express
+        for i, g, w in fallback:
+            upd(i, g, w)
+        if not groups:
+            return
+        # bump every grouped index first (the eager loop bumps one at a
+        # time, but num_update is a running max, so the per-item lr/wd
+        # read below sees the same value either way)
+        for items in groups.values():
+            for i, *_ in items:
+                o._update_count(i)
+        global _DISPATCH_COUNT
+        for (kernel, static_items, _dt), items in groups.items():
+            dtype = _raw(items[0][1]).dtype
+            w_raws = [_raw(w) for _, w, _, _, _ in items]
+            g_raws = [_raw(g) for _, _, g, _, _ in items]
+            s_raws = [[_raw(s) for s in st] for _, _, _, st, _ in items]
+            dyn_rows = [dyn_fn(o, i) for i, _, _, _, dyn_fn in items]
+            # host-side cast to the group dtype = the one rounding a
+            # weakly-typed Python float would get in the eager kernel;
+            # STACKED into one (n,) array per name so the jit pytree
+            # carries 1 leaf per scalar name, not n (the per-leaf
+            # dispatch cost of n tiny args would eat the fusion win)
+            dyn = {name: _np.asarray([row[name] for row in dyn_rows],
+                                     dtype)
+                   for name in dyn_rows[0]}
+            fn = _group_fn(kernel, static_items)
+            with profiler.annotate("optimizer_update"):
+                new_w, new_s = fn(w_raws, g_raws, s_raws, dyn)
+            _DISPATCH_COUNT += 1
+            for (_, w, _, st, _), nw, ns in zip(items, new_w, new_s):
+                w._set_data(nw)
+                for s_nd, s_new in zip(st, ns):
+                    s_nd._set_data(s_new)
+
+    # -- Updater API passthroughs (save/load states) ---------------------------
+    def sync_state_context(self, state, context):
+        return self._updater.sync_state_context(state, context)
+
+    def set_states(self, states):
+        self._updater.set_states(states)
+
+    def get_states(self, dump_optimizer=False):
+        return self._updater.get_states(dump_optimizer)

@@ -250,8 +250,11 @@ class Optimizer:
             for s, s_raw, new in zip(states, s_raws, res[1:]):
                 s._set_data(s_raw.at[idx].set(new))
             return
-        res = pure_fn(_raw(weight), _raw(grad),
-                      *[_raw(s) for s in states], **kwargs)
+        # one cached jitted program per (kernel, static hyper-params) —
+        # same trace structure as the grouped multi-tensor path, so the
+        # two produce bitwise-identical weights
+        res = _op.fused_dispatch(pure_fn, _raw(weight), _raw(grad),
+                                 [_raw(s) for s in states], kwargs)
         weight._set_data(res[0])
         for s, new in zip(states, res[1:]):
             s._set_data(new)
@@ -660,6 +663,9 @@ class LAMB(Optimizer):
         self.lower_bound = lower_bound
         self.upper_bound = upper_bound
         self.bias_correction = bias_correction
+        # trust-ratio norms need the whole tensor: row-sparse grads must
+        # densify rather than take the lazy row-slice path
+        self.lazy_update = False
 
     def create_state(self, index, weight):
         import jax.numpy as jnp
@@ -668,29 +674,29 @@ class LAMB(Optimizer):
                 _from_jax(jnp.zeros(weight.shape, dtype=weight.dtype)))
 
     def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         t = self._index_update_count[index]
         mean, var = state
-        g, new_mean, new_var = _op.lamb_update_phase1_pure(
-            _raw(weight), _raw(grad), _raw(mean), _raw(var), t=t,
-            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-            wd=wd, bias_correction=self.bias_correction, **self._common())
-        mean._set_data(new_mean)
-        var._set_data(new_var)
-        r1 = jnp.linalg.norm(_raw(weight))
-        r2 = jnp.linalg.norm(g)
+        # phase1 + trust-ratio norms + phase2 as ONE fused dispatch; the
+        # bias-correction denominators fold on the host (x/1.0 is an
+        # IEEE identity when correction is off)
+        if self.bias_correction:
+            denom1 = 1.0 - self.beta1 ** t
+            denom2 = 1.0 - self.beta2 ** t
+        else:
+            denom1 = 1.0
+            denom2 = 1.0
         kw = {}
         if self.lower_bound is not None:
             kw["lower_bound"] = self.lower_bound
         if self.upper_bound is not None:
             kw["upper_bound"] = self.upper_bound
-        (new_w,) = _op.lamb_update_phase2_pure(_raw(weight), g, r1, r2,
-                                               lr=lr, **kw)
-        weight._set_data(new_w)
+        self._apply(_op.lamb_fused_update_pure, weight, [mean, var], grad,
+                    lr=lr, wd=wd, denom1=denom1, denom2=denom2,
+                    beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, **kw, **self._common())
 
 
 @register
@@ -751,13 +757,17 @@ class FTML(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]
+        lr = self._get_lr(index)
         kw = self._common()
         # reference quirk: ftml_update takes clip_grad, not clip_gradient
         kw["clip_grad"] = kw.pop("clip_gradient", -1.0)
-        self._apply(_op.ftml_update_pure, weight, list(state), grad,
-                    lr=self._get_lr(index), wd=self._get_wd(index), t=t,
-                    beta1=self.beta1, beta2=self.beta2,
-                    epsilon=self.epsilon, **kw)
+        # the step-count coefficients fold on the host exactly as
+        # ftml_update_pure applied them, so lr/t never shape the trace
+        self._apply(_op.ftml_fused_update_pure, weight, list(state), grad,
+                    c_over_lr=(1.0 - self.beta1 ** t) / lr,
+                    coef2=1.0 - self.beta2 ** t,
+                    wd=self._get_wd(index), beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon, **kw)
 
 
 @register

@@ -161,6 +161,14 @@ def stop_xla_trace():
     return _S.xla_dir
 
 
+def annotate(name):
+    """Named phase marker for hot-path stages ("allreduce",
+    "optimizer_update", "bucket_pack", ...): a `jax.profiler.
+    TraceAnnotation` so the stage shows up named in xplane traces, plus a
+    host span when the host profiler is running."""
+    return scope(name)
+
+
 class scope:
     """Annotation scope appearing in both host + XLA traces (reference:
     profiler scopes / NVTX ranges)."""

@@ -1,0 +1,452 @@
+"""Grouped (multi-tensor) optimizer step: bitwise parity with the legacy
+per-parameter loop, dispatch-count regression, bucketed all-reduce, and
+Trainer.load_states validation."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.optimizer import grouped
+
+SHAPES = [(5, 7), (3,), (2, 3, 4), (1,), (8, 2), (4, 4)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.get(k)
+             for k in ("MXTPU_FUSED_STEP", "MXTPU_ALLREDUCE_BUCKET_MB")}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _make_params(dtype="float32", seed=0, lr_mults=None, wd_mults=None):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    params = []
+    for k, shape in enumerate(SHAPES):
+        p = gluon.Parameter(f"p{k}_weight", shape=shape, dtype=dtype)
+        p.initialize(init=mx.init.Zero())
+        p.data()._set_data(
+            jnp.asarray(rng.standard_normal(shape).astype(dtype)))
+        if lr_mults:
+            p.lr_mult = lr_mults[k % len(lr_mults)]
+        if wd_mults:
+            p.wd_mult = wd_mults[k % len(wd_mults)]
+        params.append(p)
+    return params
+
+
+def _run(optname, opt_kwargs, fused, dtype="float32", steps=5, seed=0,
+         lr_mults=None, wd_mults=None):
+    """Run `steps` Trainer.step calls with deterministic grads; return
+    final weights (and optimizer states) as numpy."""
+    import jax.numpy as jnp
+
+    os.environ["MXTPU_FUSED_STEP"] = "1" if fused else "0"
+    params = _make_params(dtype=dtype, seed=seed, lr_mults=lr_mults,
+                          wd_mults=wd_mults)
+    trainer = gluon.Trainer(params, optname, dict(opt_kwargs),
+                            kvstore=None)
+    rng = np.random.RandomState(seed + 1)
+    for _ in range(steps):
+        for p in params:
+            g = rng.standard_normal(p.shape).astype(dtype)
+            p.list_grad()[0]._set_data(jnp.asarray(g))
+        trainer.step(2, ignore_stale_grad=True)
+    return [p.data().asnumpy() for p in params]
+
+
+CONFIGS = [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+             "clip_gradient": 0.5}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "clip_gradient": 0.3}),
+    ("adamw", {"learning_rate": 1e-3, "wd": 0.01}),
+    ("rmsprop", {"learning_rate": 1e-3}),
+    ("rmsprop", {"learning_rate": 1e-3, "centered": True}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("adadelta", {"rho": 0.9, "epsilon": 1e-5}),
+    ("ftrl", {"learning_rate": 0.1, "lamda1": 0.01}),
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9, "wd_lh": 1e-5}),
+    ("lamb", {"learning_rate": 1e-3}),
+    ("lamb", {"learning_rate": 1e-3, "bias_correction": False,
+              "lower_bound": 0.1, "upper_bound": 10.0}),
+    ("lars", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("lbsgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("ftml", {"learning_rate": 1e-3}),
+]
+
+
+@pytest.mark.parametrize(
+    "optname,kwargs", CONFIGS,
+    ids=[f"{n}-{i}" for i, (n, _) in enumerate(CONFIGS)])
+def test_grouped_bitwise_parity(optname, kwargs):
+    fused = _run(optname, kwargs, fused=True)
+    legacy = _run(optname, kwargs, fused=False)
+    for f, l in zip(fused, legacy):
+        np.testing.assert_array_equal(f, l)
+
+
+def test_grouped_parity_fp16():
+    fused = _run("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                 fused=True, dtype="float16")
+    legacy = _run("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                  fused=False, dtype="float16")
+    for f, l in zip(fused, legacy):
+        np.testing.assert_array_equal(f, l)
+
+
+def test_grouped_parity_lr_scheduler():
+    kw = {"learning_rate": 0.2,
+          "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+              step=2, factor=0.5)}
+    fused = _run("sgd", dict(kw), fused=True)
+    kw = {"learning_rate": 0.2,
+          "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+              step=2, factor=0.5)}
+    legacy = _run("sgd", dict(kw), fused=False)
+    for f, l in zip(fused, legacy):
+        np.testing.assert_array_equal(f, l)
+
+
+def test_grouped_parity_lr_wd_mult():
+    mults = dict(lr_mults=[1.0, 0.5, 2.0], wd_mults=[1.0, 0.0])
+    fused = _run("sgd", {"learning_rate": 0.1, "wd": 1e-3}, fused=True,
+                 **mults)
+    legacy = _run("sgd", {"learning_rate": 0.1, "wd": 1e-3}, fused=False,
+                  **mults)
+    for f, l in zip(fused, legacy):
+        np.testing.assert_array_equal(f, l)
+
+
+# -- dispatch-count regression -------------------------------------------------
+
+def _step_once(params, trainer, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    for p in params:
+        dtype = p.data().asnumpy().dtype
+        g = rng.standard_normal(p.shape).astype(dtype)
+        p.list_grad()[0]._set_data(jnp.asarray(g))
+    trainer.step(1, ignore_stale_grad=True)
+
+
+def test_one_dispatch_per_group_per_step():
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    grouped.reset_dispatch_count()
+    for step in range(3):
+        _step_once(params, trainer, seed=step)
+        # all params share (kernel, hyper-params, f32) -> ONE program
+        assert grouped.dispatch_count() == step + 1
+
+
+def test_two_dispatches_for_mixed_dtypes():
+    import jax.numpy as jnp
+
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params(dtype="float32")
+    params += _make_params(dtype="float16", seed=7)
+    # re-wrap with unique names for the trainer's param2idx map
+    named = []
+    for i, p in enumerate(params):
+        q = gluon.Parameter(f"q{i}_weight", shape=p.shape,
+                            dtype=p.data().asnumpy().dtype)
+        q.initialize(init=mx.init.Zero())
+        q.data()._set_data(jnp.asarray(p.data().asnumpy()))
+        named.append(q)
+    trainer = gluon.Trainer(
+        named, "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                       "multi_precision": False}, kvstore=None)
+    grouped.reset_dispatch_count()
+    _step_once(named, trainer)
+    assert grouped.dispatch_count() == 2  # one f32 group + one f16 group
+
+
+def test_lars_two_groups():
+    # 1-D params take the plain momentum kernel, >=2-D the LARS kernel
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    trainer = gluon.Trainer(params, "lars",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=None)
+    grouped.reset_dispatch_count()
+    _step_once(params, trainer)
+    assert grouped.dispatch_count() == 2
+
+
+def test_fallback_optimizer_zero_dispatches():
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    before = [p.data().asnumpy().copy() for p in params]
+    trainer = gluon.Trainer(params, "nadam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    grouped.reset_dispatch_count()
+    _step_once(params, trainer)
+    assert grouped.dispatch_count() == 0  # no _PLANS entry -> legacy loop
+    for b, p in zip(before, params):
+        assert not np.array_equal(b, p.data().asnumpy())
+
+
+def test_env_gate_restores_legacy():
+    os.environ["MXTPU_FUSED_STEP"] = "0"
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    grouped.reset_dispatch_count()
+    _step_once(params, trainer)
+    assert grouped.dispatch_count() == 0
+
+
+def test_subclass_falls_back():
+    # exact-type dispatch: a subclass may override update() arbitrarily
+    class MySGD(mx.optimizer.SGD):
+        pass
+
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    trainer = gluon.Trainer(params, MySGD(learning_rate=0.1),
+                            kvstore=None)
+    grouped.reset_dispatch_count()
+    _step_once(params, trainer)
+    assert grouped.dispatch_count() == 0
+
+
+# -- state sharing / save-load -------------------------------------------------
+
+def test_states_shared_with_legacy_updater():
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    _step_once(params, trainer)
+    upd = trainer._updaters[0]
+    assert set(upd.states.keys()) == set(range(len(params)))
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "trainer.states")
+        trainer.save_states(fname)
+        trainer.load_states(fname)
+    _step_once(params, trainer, seed=1)
+
+
+def test_fused_then_legacy_continuation():
+    """Switching the flag mid-run must keep stepping the SAME states."""
+    import jax.numpy as jnp
+
+    finals = []
+    for flip_at in (None, 2):
+        os.environ["MXTPU_FUSED_STEP"] = "0" if flip_at is None else "1"
+        params = _make_params()
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        rng = np.random.RandomState(1)
+        for step in range(4):
+            if flip_at is not None and step == flip_at:
+                os.environ["MXTPU_FUSED_STEP"] = "0"
+            for p in params:
+                g = rng.standard_normal(p.shape).astype("float32")
+                p.list_grad()[0]._set_data(jnp.asarray(g))
+            trainer.step(2, ignore_stale_grad=True)
+        finals.append([p.data().asnumpy() for p in params])
+    for a, b in zip(*finals):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- Trainer.load_states validation (satellite #6) -----------------------------
+
+def _trained_state_file(d, n_params=3, shape=(4, 3)):
+    params = [gluon.Parameter(f"w{i}", shape=shape, dtype="float32")
+              for i in range(n_params)]
+    for p in params:
+        p.initialize(init=mx.init.Uniform())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    _step_once(params, trainer)
+    fname = os.path.join(d, "trainer.states")
+    trainer.save_states(fname)
+    return fname
+
+
+def test_load_states_count_mismatch():
+    with tempfile.TemporaryDirectory() as d:
+        fname = _trained_state_file(d, n_params=3)
+        params = [gluon.Parameter("w0", shape=(4, 3), dtype="float32")]
+        params[0].initialize(init=mx.init.Uniform())
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        with pytest.raises(MXNetError, match="parameter list changed"):
+            trainer.load_states(fname)
+
+
+def test_load_states_shape_mismatch():
+    with tempfile.TemporaryDirectory() as d:
+        fname = _trained_state_file(d, n_params=2, shape=(4, 3))
+        params = [gluon.Parameter(f"w{i}", shape=(5, 2), dtype="float32")
+                  for i in range(2)]
+        for p in params:
+            p.initialize(init=mx.init.Uniform())
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        with pytest.raises(MXNetError, match="does not match the "
+                                             "parameter shape"):
+            trainer.load_states(fname)
+
+
+def test_load_states_roundtrip_ok():
+    with tempfile.TemporaryDirectory() as d:
+        params = [gluon.Parameter(f"w{i}", shape=(4, 3), dtype="float32")
+                  for i in range(3)]
+        for p in params:
+            p.initialize(init=mx.init.Uniform())
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        _step_once(params, trainer)
+        fname = os.path.join(d, "trainer.states")
+        trainer.save_states(fname)
+        trainer.load_states(fname)  # same param list: no error
+
+
+# -- bucketed all-reduce -------------------------------------------------------
+
+def _kv_with_keys(n=6, seed=0, kv_type="local"):
+    from mxnet_tpu import kvstore as kvs
+
+    rng = np.random.RandomState(seed)
+    kv = kvs.create(kv_type)
+    vals = []
+    for k in range(n):
+        shape = SHAPES[k % len(SHAPES)]
+        v = mx.nd.array(rng.standard_normal(shape).astype("float32"))
+        kv.init(k, v)
+        vals.append(v)
+    return kv, vals
+
+
+def test_bucketed_pushpull_matches_per_key():
+    rng = np.random.RandomState(3)
+    grads = [rng.standard_normal(SHAPES[k % len(SHAPES)])
+             .astype("float32") for k in range(6)]
+
+    kv1, _ = _kv_with_keys()
+    outs1 = [mx.nd.array(g) for g in grads]
+    for k, v in enumerate(outs1):
+        kv1.pushpull(k, v, out=v)
+
+    kv2, _ = _kv_with_keys()
+    outs2 = [mx.nd.array(g) for g in grads]
+    kv2.bucketed_pushpull(list(range(6)), outs2, outs=outs2)
+
+    for a, b in zip(outs1, outs2):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_bucketed_pushpull_tiny_buckets():
+    # a 100-byte budget forces one bucket per key; results must not change
+    os.environ["MXTPU_ALLREDUCE_BUCKET_MB"] = "0.0001"
+    rng = np.random.RandomState(4)
+    grads = [rng.standard_normal(SHAPES[k % len(SHAPES)])
+             .astype("float32") for k in range(6)]
+
+    kv1, _ = _kv_with_keys()
+    outs1 = [mx.nd.array(g) for g in grads]
+    for k, v in enumerate(outs1):
+        kv1.pushpull(k, v, out=v)
+
+    kv2, _ = _kv_with_keys()
+    outs2 = [mx.nd.array(g) for g in grads]
+    kv2.bucketed_pushpull(list(range(6)), outs2, outs=outs2)
+
+    for a, b in zip(outs1, outs2):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_bucketed_pushpull_device_lists():
+    # multi-device value lists are summed per key, like pushpull
+    kv, _ = _kv_with_keys(n=2)
+    a = mx.nd.array(np.ones((5, 7), np.float32))
+    b = mx.nd.array(np.full((5, 7), 2.0, np.float32))
+    c = mx.nd.array(np.ones((3,), np.float32))
+    out0 = mx.nd.array(np.zeros((5, 7), np.float32))
+    out1 = mx.nd.array(np.zeros((3,), np.float32))
+    kv.bucketed_pushpull([0, 1], [[a, b], [c]], outs=[[out0], [out1]])
+    np.testing.assert_array_equal(out0.asnumpy(),
+                                  np.full((5, 7), 3.0, np.float32))
+    np.testing.assert_array_equal(out1.asnumpy(),
+                                  np.ones((3,), np.float32))
+
+
+def test_bucketed_pushpull_uninit_key():
+    kv, _ = _kv_with_keys(n=2)
+    v = mx.nd.array(np.zeros((5, 7), np.float32))
+    with pytest.raises(MXNetError):
+        kv.bucketed_pushpull([99], [v], outs=[v])
+
+
+def test_bucketed_pushpull_compression_fallback():
+    # active compression keeps per-key error-feedback residuals: the
+    # bucketed entry point must give the same answer as per-key pushpull
+    from mxnet_tpu import kvstore as kvs
+
+    rng = np.random.RandomState(5)
+    grads = [rng.standard_normal((5, 7)).astype("float32")
+             for _ in range(3)]
+
+    results = []
+    for _ in range(2):
+        kv = kvs.create("device")
+        kv.set_gradient_compression({"type": "fp16"})
+        for k in range(3):
+            kv.init(k, mx.nd.array(np.zeros((5, 7), np.float32)))
+        results.append(kv)
+    kv1, kv2 = results
+
+    outs1 = [mx.nd.array(g) for g in grads]
+    for k, v in enumerate(outs1):
+        kv1.pushpull(k, v, out=v)
+    outs2 = [mx.nd.array(g) for g in grads]
+    kv2.bucketed_pushpull([0, 1, 2], outs2, outs=outs2)
+    for a, b in zip(outs1, outs2):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_trainer_uses_bucketed_allreduce(monkeypatch):
+    """Trainer._allreduce_grads routes through bucketed_pushpull when the
+    fused path is on and the kvstore supports it."""
+    from mxnet_tpu import kvstore as kvs
+
+    os.environ["MXTPU_FUSED_STEP"] = "1"
+    params = _make_params()
+    kv = kvs.create("local")
+    calls = []
+    orig = kv.bucketed_pushpull
+
+    def spy(keys, values, outs=None, priority=0):
+        calls.append(list(keys))
+        return orig(keys, values, outs=outs, priority=priority)
+
+    monkeypatch.setattr(kv, "bucketed_pushpull", spy)
+    # force the trainer to keep the local store (it normally drops it
+    # for a single worker)
+    from mxnet_tpu.gluon import trainer as trainer_mod
+    monkeypatch.setattr(trainer_mod, "kvstore_requires_store",
+                        lambda _kv: True)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=kv, update_on_kvstore=False)
+    _step_once(params, trainer)
+    assert calls and calls[0] == list(range(len(params)))

@@ -133,3 +133,38 @@ def test_lstmbias():
     out = arr.asnumpy()
     np.testing.assert_allclose(out[2:4], np.ones(2))
     np.testing.assert_allclose(out[:2], np.zeros(2))
+
+
+def test_accuracy_device_numpy_parity():
+    """Device-side fused accuracy (NDArray inputs) must agree exactly
+    with the host numpy path (plain array inputs)."""
+    rng = np.random.RandomState(0)
+    pred = rng.rand(64, 10).astype("float32")
+    label = rng.randint(0, 10, size=(64,)).astype("float32")
+    dev = metric.Accuracy()
+    dev.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    host = metric.Accuracy()
+    host.update([label], [pred])
+    assert dev.get() == host.get()
+    # same-shape (no argmax) comparison path
+    dev2 = metric.Accuracy()
+    dev2.update([mx.nd.array([0, 1, 1])], [mx.nd.array([0, 1, 0])])
+    assert dev2.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_topk_device_numpy_parity():
+    rng = np.random.RandomState(1)
+    pred = rng.rand(64, 10).astype("float32")
+    label = rng.randint(0, 10, size=(64,)).astype("float32")
+    for k in (2, 3, 5):
+        dev = metric.TopKAccuracy(top_k=k)
+        dev.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        host = metric.TopKAccuracy(top_k=k)
+        host.update([label], [pred])
+        assert dev.get() == host.get()
+
+
+def test_accuracy_device_shape_mismatch_error():
+    m = metric.Accuracy()
+    with pytest.raises(ValueError, match="Shape of labels"):
+        m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.1, 0.9]])])
