@@ -75,7 +75,10 @@ def main():
             while True:
                 yield x, y
 
-    gen = batches()
+    # prefetch + place batches with the data-parallel sharding up front:
+    # trainer.step's device_put then finds them already distributed and
+    # the h2d copy of batch N+1 overlaps the step on batch N
+    gen = iter(gluon.data.DevicePrefetcher(batches(), mesh=mesh))
     t0 = None
     for step in range(args.steps):
         x, y = next(gen)

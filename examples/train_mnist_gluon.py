@@ -54,6 +54,10 @@ def main():
     args = parser.parse_args()
 
     train_iter, val_iter = get_data(args.data_dir, args.batch_size)
+    # keep MXTPU_DEVICE_PREFETCH batches in flight on device so the h2d
+    # copy of the next batch overlaps the current step
+    train_iter = gluon.data.DevicePrefetcher(train_iter)
+    val_iter = gluon.data.DevicePrefetcher(val_iter)
 
     net = nn.HybridSequential()
     with net.name_scope():

@@ -7,6 +7,16 @@ from __future__ import annotations
 import time
 
 from ... import autograd, metric as metric_mod
+from ..data.prefetcher import DevicePrefetcher, default_depth
+
+
+def _maybe_prefetch(data):
+    """Wrap a batch source in DevicePrefetcher (unless prefetch is
+    disabled via MXTPU_DEVICE_PREFETCH=0, or it's already wrapped)."""
+    if data is None or isinstance(data, DevicePrefetcher) \
+            or default_depth() <= 0:
+        return data
+    return DevicePrefetcher(data)
 
 
 class Estimator:
@@ -37,6 +47,9 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=1,
             batch_end_callback=None, epoch_end_callback=None):
+        # device prefetch: batch N+1's h2d copy overlaps batch N's step
+        train_data = _maybe_prefetch(train_data)
+        val_data = _maybe_prefetch(val_data)
         for epoch in range(epochs):
             tic = time.time()
             for m in self.train_metrics:

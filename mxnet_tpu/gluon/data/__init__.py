@@ -4,6 +4,7 @@ from .dataset import (ArrayDataset, Dataset, RecordFileDataset,
                       SimpleDataset)
 from .sampler import (BatchSampler, FilterSampler, RandomSampler, Sampler,
                       SequentialSampler)
-from .dataloader import (DataLoader, default_batchify_fn,
-                         default_mp_batchify_fn)
+from .dataloader import (DataLoader, DataLoaderWorkerError,
+                         default_batchify_fn, default_mp_batchify_fn)
+from .prefetcher import DevicePrefetcher
 from . import vision

@@ -3,48 +3,85 @@
 Reference parity: python/mxnet/gluon/data/dataloader.py — DataLoader with
 batchify (default_batchify_fn), samplers, and multi-worker loading.
 
-TPU-first note: the reference uses multiprocessing workers with shared-memory
-NDArrays.  Host-side decode/augment here uses a thread pool by default
-(numpy/PIL release the GIL for the heavy parts, and threads avoid
-re-importing jax per worker); ``thread_pool=False`` with num_workers>0 uses
-processes with pickled numpy batches.
+TPU-first notes:
+
+- **Single-copy collation**: ``default_batchify_fn`` collates samples into
+  one preallocated contiguous host buffer and issues exactly ONE async
+  ``jax.device_put`` per batch array — no per-sample host→device
+  transfers, no device-side ``jnp.stack`` (the pre-round-3 path issued
+  one transfer per *sample*; see docs/perf.md "Input pipeline").
+- **Workers**: host-side decode/augment uses a thread pool by default
+  (numpy/PIL release the GIL for the heavy parts, and threads avoid
+  re-importing jax per worker); ``thread_pool=False`` with num_workers>0
+  spawns processes that transport batches through shared-memory ring
+  slots (``_shm_worker.py``) instead of pickling, with out-of-order
+  completion and in-order delivery — a slow worker delays only its own
+  batch.  ``MXTPU_SHM_SLOT_MB`` sizes the ring slots; oversized batches
+  fall back to pickle transport transparently.
+- Device placement overlap lives one layer up: wrap any loader in
+  ``mxnet_tpu.gluon.data.DevicePrefetcher`` (prefetcher.py).
 """
 
 from __future__ import annotations
 
 import multiprocessing
-import multiprocessing.pool
+import os
+import queue as _queue
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
 
 from ...ndarray.ndarray import NDArray, _from_jax
 from . import sampler as _sampler
+from . import _shm_worker
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """A dataset ``__getitem__``/batchify raised inside a loader worker.
+
+    Carries the failing batch's sample indices and (for process workers)
+    the worker-side traceback, instead of the opaque pickling/timeout
+    error the raw transport would produce."""
+
+
+def _on_host(nd):
+    """True when an NDArray's buffer lives on the host platform (so a
+    per-sample ``asnumpy`` is a cheap view/copy, not a device readback)."""
+    try:
+        return next(iter(nd._data.devices())).platform == "cpu"
+    except Exception:
+        return True
+
+
+def _wrap_device(collated):
+    """One async ``jax.device_put`` per collated batch array."""
+    if isinstance(collated, list):
+        return [_wrap_device(c) for c in collated]
+    import jax
+
+    return _from_jax(jax.device_put(collated))
 
 
 def default_batchify_fn(data):
-    """Stack samples into a batch (reference: default_batchify_fn)."""
-    if isinstance(data[0], NDArray):
+    """Stack samples into a batch (reference: default_batchify_fn).
+
+    Collates on the host into one contiguous buffer per output array and
+    performs a single async device transfer per array."""
+    if isinstance(data[0], NDArray) and not _on_host(data[0]):
+        # device-resident samples: stacking on-device beats a readback
         import jax.numpy as jnp
 
         return _from_jax(jnp.stack([d._data for d in data]))
     if isinstance(data[0], tuple):
         data = zip(*data)
-        return [default_batchify_fn(i) for i in data]
-    data = _np.asarray(data)
-    import jax.numpy as jnp
-
-    return _from_jax(jnp.asarray(data))
+        return [default_batchify_fn(list(i)) for i in data]
+    return _wrap_device(_shm_worker.collate_column(data))
 
 
 def default_mp_batchify_fn(data):
-    """Batchify in a worker: keep numpy (cheap pickling), wrap in parent."""
-    if isinstance(data[0], NDArray):
-        return _np.stack([d.asnumpy() for d in data])
-    if isinstance(data[0], tuple):
-        data = zip(*data)
-        return [default_mp_batchify_fn(i) for i in data]
-    return _np.asarray(data)
+    """Batchify in a worker: keep numpy (single-copy collation into a
+    contiguous buffer); the parent wraps with one device_put per array."""
+    return _shm_worker.collate_samples(data)
 
 
 def _as_in_context(data, ctx):
@@ -72,7 +109,8 @@ class DataLoader:
     Parameters follow the reference: dataset, batch_size, shuffle, sampler,
     last_batch ('keep'|'discard'|'rollover'), batch_sampler, batchify_fn,
     num_workers, pin_memory (ignored: XLA host buffers are already pinned),
-    prefetch, thread_pool.
+    prefetch (None -> 2*num_workers; 0 -> at most one batch in flight),
+    thread_pool.
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
@@ -109,6 +147,7 @@ class DataLoader:
         self._num_workers = num_workers if num_workers >= 0 else 0
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * self._num_workers)
+        self._custom_batchify = batchify_fn is not None
         if batchify_fn is None:
             if num_workers > 0 and not thread_pool:
                 self._batchify_fn = default_mp_batchify_fn
@@ -131,59 +170,181 @@ class DataLoader:
         return len(self._batch_sampler)
 
 
+def _slot_bytes():
+    return int(float(os.environ.get("MXTPU_SHM_SLOT_MB", 32)) * (1 << 20))
+
+
 class _MultiWorkerIter:
-    """Pool-based prefetching iterator."""
+    """Prefetching iterator over pool workers.
+
+    Thread pool: futures are delivered in submit order; the executor runs
+    them concurrently.  Process pool: workers pull from a shared task
+    queue (out-of-order completion), results are reordered in the parent
+    so delivery matches the sampler order — identical batches, identical
+    order, regardless of transport.
+
+    The iterator owns OS resources; it cleans up on exhaustion, on
+    ``close()``, on ``__del__`` (abandoned mid-epoch), and supports use
+    as a context manager.
+    """
 
     def __init__(self, loader):
         self._loader = loader
-        self._worker = _Worker(loader._dataset, loader._batchify_fn)
-        if loader._thread_pool:
-            self._pool = ThreadPoolExecutor(
-                max_workers=loader._num_workers)
-            self._submit = self._pool.submit
-        else:
-            self._mp_pool = multiprocessing.get_context("spawn").Pool(
-                loader._num_workers)
-            self._submit = lambda fn, arg: self._mp_pool.apply_async(fn,
-                                                                     (arg,))
         self._batches = iter(loader._batch_sampler)
-        self._pending = []
-        self._done = False
-        for _ in range(max(1, loader._prefetch)):
+        self._depth = max(1, loader._prefetch)
+        self._sent_idx = 0
+        self._rcvd_idx = 0
+        self._data_buffer = {}  # batch_idx -> result record
+        self._closed = False
+        self._pool = None
+        self._procs = []
+        if loader._thread_pool:
+            self._worker = _Worker(loader._dataset, loader._batchify_fn)
+            self._pool = ThreadPoolExecutor(max_workers=loader._num_workers)
+        else:
+            self._start_processes(loader)
+        for _ in range(self._depth):
             self._push_next()
 
+    # -- process transport -----------------------------------------------------
+
+    def _start_processes(self, loader):
+        ctx = multiprocessing.get_context("spawn")
+        nslots = max(self._depth, loader._num_workers)
+        self._slots = [ctx.RawArray("b", _slot_bytes())
+                       for _ in range(nslots)]
+        self._free_slots = list(range(nslots))
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        # None selects the jax-free built-in collation in the worker; a
+        # pickled reference to the default fn would drag the whole
+        # package (and jax) into every spawned child
+        fn = loader._batchify_fn if loader._custom_batchify else None
+        for _ in range(loader._num_workers):
+            p = ctx.Process(
+                target=_shm_worker.worker_loop,
+                args=(loader._dataset, fn, self._slots, self._task_q,
+                      self._result_q),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+
     def _push_next(self):
+        if self._closed:
+            return
+        if self._pool is None and not self._free_slots:
+            return  # every ring slot is in flight
         batch = next(self._batches, None)
         if batch is None:
             return
-        self._pending.append(self._submit(self._worker, batch))
+        if self._pool is not None:
+            fut = self._pool.submit(self._worker, batch)
+            self._data_buffer[self._sent_idx] = ("future", fut, batch)
+        else:
+            slot = self._free_slots.pop()
+            self._task_q.put((self._sent_idx, slot, list(batch)))
+        self._sent_idx += 1
+
+    def _recv_until(self, idx):
+        """Drain the result queue until batch `idx` has arrived.
+
+        Slots are copied out and recycled at *receive* time, not delivery
+        time, so an out-of-order fast batch never pins a slot while a
+        slow one is pending."""
+        while idx not in self._data_buffer:
+            try:
+                msg = self._result_q.get(timeout=self._loader._timeout)
+            except _queue.Empty:
+                self.close()
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker result for batch {idx} not "
+                    f"received within timeout={self._loader._timeout}s")
+            tag, bidx, slot, payload, is_list = msg
+            if tag == "shm":
+                out = _shm_worker.read_slot(self._slots[slot], payload,
+                                            is_list)
+                self._data_buffer[bidx] = ("data", out, None)
+            elif tag == "pickle":
+                self._data_buffer[bidx] = ("data", payload, None)
+            else:  # "error"
+                self._data_buffer[bidx] = ("error", payload, None)
+            if slot is not None:
+                self._free_slots.append(slot)
+                self._push_next()
+
+    # -- iteration -------------------------------------------------------------
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if not self._pending:
-            self._shutdown()
+        if self._rcvd_idx == self._sent_idx or self._closed:
+            self.close()
             raise StopIteration
-        fut = self._pending.pop(0)
-        self._push_next()
-        if hasattr(fut, "result"):
-            out = fut.result(timeout=self._loader._timeout)
+        idx = self._rcvd_idx
+        if self._pool is not None:
+            kind, fut, samples = self._data_buffer.pop(idx)
+            self._rcvd_idx += 1
+            self._push_next()
+            try:
+                out = fut.result(timeout=self._loader._timeout)
+            except Exception as err:
+                self.close()
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker failed on batch {idx} (sample "
+                    f"indices {list(samples)}): {err!r}") from err
         else:
-            out = fut.get(timeout=self._loader._timeout)
+            self._recv_until(idx)
+            kind, out, _ = self._data_buffer.pop(idx)
+            self._rcvd_idx += 1
+            if kind == "error":
+                exc_repr, tb, samples = out
+                self.close()
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker failed on batch {idx} (sample "
+                    f"indices {samples}): {exc_repr}\n"
+                    f"--- worker traceback ---\n{tb}")
         if isinstance(out, _np.ndarray) or (
                 isinstance(out, list)
                 and out and isinstance(out[0], _np.ndarray)):
-            # mp path returns numpy; wrap on the parent process
-            import jax.numpy as jnp
-
-            if isinstance(out, list):
-                return [_from_jax(jnp.asarray(o)) for o in out]
-            return _from_jax(jnp.asarray(out))
+            # worker transports host numpy; one device_put per array here
+            return _wrap_device(out)
         return out
 
-    def _shutdown(self):
-        if hasattr(self, "_pool"):
-            self._pool.shutdown(wait=False)
-        if hasattr(self, "_mp_pool"):
-            self._mp_pool.terminate()
+    # -- cleanup ---------------------------------------------------------------
+
+    def close(self):
+        """Cancel pending work and release threads/processes/queues."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except (ValueError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1)
+        if self._procs:
+            for q in (self._task_q, self._result_q):
+                q.cancel_join_thread()
+                q.close()
+        self._data_buffer.clear()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

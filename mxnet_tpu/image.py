@@ -174,6 +174,37 @@ def random_crop(src, size, interp=2):
     return _to_nd(out), (x0, y0, new_w, new_h)
 
 
+def normalize_flip_batch_np(batch_hwc, mirror, scale, mean, std, out=None):
+    """Batch-level vectorized mirror + cast + scale/mean/std normalize +
+    NHWC→NCHW, replacing the per-sample float copies in the record-iter
+    python path.
+
+    ``batch_hwc`` is (N, H, W, C) (typically uint8, flipped IN PLACE for
+    mirrored rows); ``mirror`` a length-N bool mask (or None); ``mean`` /
+    ``std`` float32 arrays broadcastable against (C, 1, 1).  Writes the
+    normalized NCHW float32 batch into ``out`` (allocated when None).
+
+    The op sequence — flip on the integer pixels, cast the whole batch to
+    float32, then in-place ``*= scale``, ``-= mean``, ``/= std`` — is
+    element-wise the same float32 arithmetic as the per-sample reference
+    path ``(chw.astype(f32) * scale - mean) / std``, so results are
+    bit-identical to it (and to the native decode kernel).
+    """
+    batch_hwc = _np.asarray(batch_hwc)
+    n, hh, ww, cc = batch_hwc.shape
+    if mirror is not None:
+        mirror = _np.asarray(mirror, dtype=bool)
+        if mirror.any():
+            batch_hwc[mirror] = batch_hwc[mirror, :, ::-1]
+    if out is None:
+        out = _np.empty((n, cc, hh, ww), dtype=_np.float32)
+    _np.copyto(out, batch_hwc.transpose(0, 3, 1, 2))
+    out *= scale
+    out -= mean
+    out /= std
+    return out
+
+
 def color_normalize(src, mean, std=None):
     src = _to_np(src).astype(_np.float32)
     mean = _to_np(mean) if mean is not None else None
@@ -453,6 +484,19 @@ class ImageIter:
         if aug_list is None:
             aug_list = CreateAugmenter(data_shape=(3,) + self.data_shape[1:])
         self.auglist = aug_list
+        # Split off the maximal suffix of batch-vectorizable augmenters:
+        # flip/cast/normalize run once on the whole collated batch instead
+        # of per sample (each per-sample call round-trips through an
+        # NDArray, i.e. a device transfer per aug per sample).  Flip
+        # DECISIONS are still drawn per sample inside the loop so the RNG
+        # stream — and therefore every pixel — matches the unsplit path.
+        split = len(aug_list)
+        while split > 0 and isinstance(
+                aug_list[split - 1],
+                (HorizontalFlipAug, CastAug, ColorNormalizeAug)):
+            split -= 1
+        self._aug_head = aug_list[:split]
+        self._aug_tail = aug_list[split:]
         self._order = _np.arange(len(self._items))
         self.cur = 0
         self.reset()
@@ -492,6 +536,8 @@ class ImageIter:
         data = _np.empty((self.batch_size, c, h, w), dtype=_np.float32)
         label = _np.empty((self.batch_size, self.label_width),
                           dtype=_np.float32)
+        batch_hwc = None
+        mirror = _np.zeros(self.batch_size, dtype=bool)
         for i in range(self.batch_size):
             kind, item = self._items[self._order[self.cur + i]]
             if kind == "rec":
@@ -501,11 +547,34 @@ class ImageIter:
             else:
                 path, lab = item
                 img = imread(path)
-            for aug in self.auglist:
+            for aug in self._aug_head:
                 img = aug(img)
-            arr = _to_np(img).astype(_np.float32)
-            data[i] = arr.transpose(2, 0, 1)
+            # draw here, at this sample's position in the pipeline, so the
+            # RNG stream matches running the full aug list per sample
+            for aug in self._aug_tail:
+                if isinstance(aug, HorizontalFlipAug):
+                    mirror[i] = _pyrandom.random() < aug.p
+            arr = _to_np(img)
+            if batch_hwc is None:
+                batch_hwc = _np.empty((self.batch_size,) + arr.shape,
+                                      arr.dtype)
+            batch_hwc[i] = arr
             label[i] = lab if _np.ndim(lab) else [lab] * self.label_width
+        batch = batch_hwc
+        for aug in self._aug_tail:
+            if isinstance(aug, HorizontalFlipAug):
+                if mirror.any():
+                    batch[mirror] = batch[mirror, :, ::-1]
+            elif isinstance(aug, CastAug):
+                batch = batch.astype(aug.typ)
+            else:  # ColorNormalizeAug — float64 intermediate like
+                   # color_normalize, single downcast at the copyto below
+                batch = batch.astype(_np.float32)
+                if aug.mean is not None:
+                    batch = batch - _to_np(aug.mean)
+                if aug.std is not None:
+                    batch = batch / _to_np(aug.std)
+        _np.copyto(data, batch.transpose(0, 3, 1, 2))
         self.cur += self.batch_size
         import jax.numpy as jnp
 

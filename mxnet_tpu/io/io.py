@@ -193,12 +193,22 @@ class PrefetchingIter(DataIter):
         self.next_batch = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
+            # device placement happens HERE, on the prefetch thread, so
+            # the h2d copy of batch N+1 overlaps the step on batch N
+            # (MXTPU_DEVICE_PREFETCH=0 keeps batches as produced; the
+            # consumer then pays the transfer synchronously)
+            from ..gluon.data import prefetcher as _prefetcher
+
+            placing = _prefetcher.default_depth() > 0
             while True:
                 self.data_taken[i].wait()
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
+                    if placing:
+                        batch = _prefetcher.place(batch)
+                    self.next_batch[i] = batch
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -669,16 +679,21 @@ class ImageRecordIter(DataIter):
         self.cursor = n
         return idx
 
-    def _decode_one(self, payload, mirror_flag):
-        """Python/PIL fallback for one image -> normalized CHW."""
+    def _decode_crop_one(self, payload):
+        """Python/PIL fallback: decode + resize + crop one image -> HWC
+        uint8 (mirror/normalize happen batch-vectorized afterwards)."""
         _, h, w = self.data_shape
         arr = self._img.imdecode_np(payload)  # HWC uint8
         if self.resize > 0:
             arr = self._img.resize_short_np(arr, self.resize)
         if self.rand_crop:
-            arr = self._img.random_crop_np(arr, (w, h))
-        else:
-            arr = self._img.center_crop_np(arr, (w, h))
+            return self._img.random_crop_np(arr, (w, h))
+        return self._img.center_crop_np(arr, (w, h))
+
+    def _decode_one(self, payload, mirror_flag):
+        """Fully-processed single image -> normalized CHW (used for the
+        sparse non-JPEG stragglers inside a native-decoded batch)."""
+        arr = self._decode_crop_one(payload)
         if mirror_flag:
             arr = arr[:, ::-1, :]
         chw = arr.astype(_np.float32).transpose(2, 0, 1)
@@ -725,8 +740,19 @@ class ImageRecordIter(DataIter):
                 # non-JPEG payloads (e.g. PNG): python codec fallback
                 data[i] = self._decode_one(payloads[i], mirror[i])
         else:
+            # pure-python batch: per-sample decode/crop into one uint8
+            # NHWC staging buffer, then ONE vectorized flip+normalize
+            # pass straight into the float32 output (bit-identical to the
+            # old per-sample float path — see normalize_flip_batch_np)
+            u8 = None
             for i in range(self.batch_size):
-                data[i] = self._decode_one(payloads[i], mirror[i])
+                arr = self._decode_crop_one(payloads[i])
+                if u8 is None:
+                    u8 = _np.empty((self.batch_size,) + arr.shape,
+                                   arr.dtype)
+                u8[i] = arr
+            self._img.normalize_flip_batch_np(
+                u8, mirror, self.scale, self.mean, self.std, out=data)
         # cursor was already advanced by _next_indices — advancing here
         # too skipped every other batch of the epoch
         return DataBatch(
