@@ -296,6 +296,11 @@ def orchestrate():
         headline["trainer_step_us_legacy"] = trainer_bench.get("legacy_us")
         headline["trainer_step_speedup"] = trainer_bench.get("speedup")
         headline["trainer_step_params"] = trainer_bench.get("params")
+        headline["guard_overhead_us"] = \
+            trainer_bench.get("guard_overhead_us")
+        headline["guard_overhead_pct"] = \
+            trainer_bench.get("guard_overhead_pct")
+        headline["guard_ok"] = trainer_bench.get("guard_ok")
     elif trainer_errors:
         headline["trainer_error"] = "; ".join(trainer_errors)[-300:]
     if pipe is not None:
@@ -701,6 +706,23 @@ def bench_trainer(cfg, devices):
     dt, _ = _timed_loop(step, steps)
     fused_us = dt / steps * 1e6
 
+    # guard_overhead_us: the fused numerical-health guard (default on —
+    # fused_us above already paid for it) vs MXTPU_GRAD_GUARD=0.  The
+    # guard adds one tiny jit dispatch + one deferred scalar readback
+    # per step; target <5% of trainer_step_us (guard_ok; informational
+    # on CPU, where dispatch overhead dominates absolute step time).
+    os.environ["MXTPU_GRAD_GUARD"] = "0"
+    try:
+        _readback(step())
+        _readback(step())
+        dt3, _ = _timed_loop(step, steps)
+        noguard_us = dt3 / steps * 1e6
+    finally:
+        os.environ.pop("MXTPU_GRAD_GUARD", None)
+    guard_overhead_us = fused_us - noguard_us
+    guard_overhead_pct = guard_overhead_us / noguard_us * 100 \
+        if noguard_us else None
+
     # legacy per-parameter loop, same process (the flag is read per step)
     os.environ["MXTPU_FUSED_STEP"] = "0"
     try:
@@ -720,6 +742,11 @@ def bench_trainer(cfg, devices):
         "vs_baseline": None,
         "legacy_us": round(legacy_us, 1),
         "speedup": round(legacy_us / fused_us, 2) if fused_us else None,
+        "guard_overhead_us": round(guard_overhead_us, 1),
+        "guard_overhead_pct": round(guard_overhead_pct, 1)
+        if guard_overhead_pct is not None else None,
+        "guard_ok": guard_overhead_pct is not None
+        and guard_overhead_pct < 5.0,
         "params": actual,
         "batch": n_params,
         "backend": devices[0].platform,

@@ -7,6 +7,13 @@ Trains a gluon MLP under ``resilience.run_resilient`` and — unless
 window, restarts in-process, resumes from the checkpoint, and finishes
 every step; the final report shows the recovery.  Delete nothing and run
 again with the same ``--ckpt-dir`` to watch it resume across processes.
+
+``--nan-step N`` demonstrates the numerical half of the story instead
+(docs/resilience.md "Numerical resilience"): step N's gradients are
+poisoned with NaN through the ``nan_grad`` fault site, the fused guard
+skips the step with weights untouched (``trainer.skipped_steps``), a
+``numerics.DivergenceMonitor`` watches the loss EWMA, and the run still
+converges.
 """
 
 import argparse
@@ -20,11 +27,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon, resilience
+from mxnet_tpu import autograd, gluon, numerics, resilience
 from mxnet_tpu.gluon import nn
 
 
-def build(batch_size, seed=7):
+def build(batch_size, seed=7, nan_step=None):
     mx.random.seed(seed)
     rng = np.random.RandomState(seed)
     centers = rng.uniform(-2, 2, (4, 16)).astype(np.float32)
@@ -45,6 +52,10 @@ def build(batch_size, seed=7):
     params = net.collect_params()
 
     def step_fn(step):
+        if nan_step is not None and step == nan_step:
+            # arm the nan_grad site so THIS step's gradients are poisoned
+            os.environ["MXTPU_FAULT_INJECT"] = "nan_grad:1"
+            resilience.reset_faults()
         data, label = batches[step % len(batches)]
         with autograd.record():
             loss = loss_fn(net(data), label)
@@ -59,7 +70,7 @@ def build(batch_size, seed=7):
         for k, v in state.items():
             params[k].set_data(mx.nd.array(v))
 
-    return step_fn, get_state, set_state
+    return step_fn, get_state, set_state, trainer
 
 
 def main():
@@ -74,18 +85,29 @@ def main():
                         help="inject a SIGTERM preemption at this step")
     parser.add_argument("--no-fault", action="store_true",
                         help="run without the injected preemption")
+    parser.add_argument("--nan-step", type=int, default=None,
+                        help="poison this step's gradients with NaN "
+                             "instead of the SIGTERM demo (numerical-"
+                             "health guard)")
     args = parser.parse_args()
 
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="resilient_ckpt_")
-    if not args.no_fault and "MXTPU_FAULT_INJECT" not in os.environ:
+    inject_sigterm = args.no_fault is False and args.nan_step is None
+    if inject_sigterm and "MXTPU_FAULT_INJECT" not in os.environ:
         os.environ["MXTPU_FAULT_INJECT"] = \
             f"sigterm_at_step:{args.crash_step}"
         resilience.reset_faults()
         print(f"injecting preemption: "
               f"MXTPU_FAULT_INJECT={os.environ['MXTPU_FAULT_INJECT']}")
 
-    step_fn, get_state, set_state = build(args.batch_size)
+    step_fn, get_state, set_state, trainer = build(
+        args.batch_size, nan_step=args.nan_step)
     ck = resilience.LocalCheckpointer(ckpt_dir, max_to_keep=3)
+    if args.nan_step is not None:
+        # divergence watchdog: rolls back to the last snapshot if the
+        # run ever goes unhealthy for MXTPU_MAX_BAD_STEPS in a row
+        trainer.divergence_monitor = numerics.DivergenceMonitor(
+            checkpointer=ck, set_state=set_state)
     report = resilience.run_resilient(
         step_fn, ck, args.steps, get_state=get_state,
         set_state=set_state, checkpoint_every=args.checkpoint_every,
@@ -96,11 +118,17 @@ def main():
     print(f"{report}")
     print(f"loss {first:.4f} -> {last:.4f} over {report.final_step} steps")
     assert report.final_step == args.steps
-    if not args.no_fault:
+    if inject_sigterm:
         assert report.preempted and report.restarts >= 1
         print(f"preempted at step {args.crash_step}, checkpointed, "
               f"resumed from step {report.resumed_from[-1]}: "
               f"recovery OK")
+    if args.nan_step is not None:
+        assert trainer.skipped_steps, \
+            "the poisoned step was not skipped (is MXTPU_GRAD_GUARD off?)"
+        print(f"NaN gradient at step {args.nan_step} -> "
+              f"{trainer.skipped_steps[-1]}: weights untouched, run "
+              f"converged anyway")
     assert last < first, "loss did not decrease"
     if args.ckpt_dir is None:
         shutil.rmtree(ckpt_dir, ignore_errors=True)

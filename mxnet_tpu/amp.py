@@ -59,40 +59,77 @@ def convert_model(sym, arg_params, aux_params, target_dtype=None):
 
 class DynamicLossScaler:
     """Loss scaling for float16 training (reference: the AMP loss scaler;
-    unnecessary under bfloat16)."""
+    unnecessary under bfloat16).
+
+    ``tolerance`` is the fairseq-style overflow budget: on an overflow
+    the scale halves only when the fraction of overflowed steps since
+    the last rescale is at least ``tolerance``; the default 0.0 means
+    every overflow halves (the classic behavior).  Growth is capped at
+    ``max(init_scale, 2**16)`` — an unbounded doubling schedule would
+    walk the scale to f32 infinity during a long clean stretch.
+    """
 
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
                  scale_window=2000, tolerance=0.0):
         self.loss_scale = init_scale
         self.scale_factor = scale_factor
         self.scale_window = scale_window
+        self.tolerance = tolerance
+        self._max_scale = max(init_scale, 2.0 ** 16)
         self._unskipped = 0
+        self._iter = 0
+        self._last_rescale_iter = 0
+        self._overflows_since_rescale = 0
 
     def scale(self, loss):
         return loss * self.loss_scale
 
     def unscale(self, grads):
+        """Return the gradients divided by the current scale.  JAX
+        arrays are immutable, so this RETURNS new arrays — it cannot
+        rewrite the inputs in place (the reference's ``g *= inv`` was a
+        silent no-op here).  The Trainer path does not need this at all:
+        it folds ``1/loss_scale`` into ``rescale_grad`` inside the fused
+        step."""
         inv = 1.0 / self.loss_scale
-        for g in grads:
-            g *= inv
-        return grads
+        return [g * inv for g in grads]
 
     def has_overflow(self, grads):
+        """One fused device reduction + ONE host readback over all
+        gradients (the per-gradient ``asnumpy()`` loop this replaces
+        forced a pipeline bubble per parameter)."""
+        from . import numerics
+
+        raws = []
         for g in grads:
-            a = g.asnumpy() if hasattr(g, "asnumpy") else _np.asarray(g)
-            if not _np.all(_np.isfinite(a)):
-                return True
-        return False
+            raw = getattr(g, "_data", None)
+            raws.append(raw if raw is not None else _np.asarray(g))
+        if not raws:
+            return False
+        guard = numerics.StepGuard(numerics.grad_health(raws))
+        return not guard.healthy
 
     def update_scale(self, overflow):
-        """Halve on overflow; double after scale_window clean steps."""
+        """Halve on overflow (subject to ``tolerance``); double after
+        scale_window clean steps, capped at the growth ceiling."""
+        self._iter += 1
         if overflow:
-            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._overflows_since_rescale += 1
+            pct = self._overflows_since_rescale / \
+                max(1, self._iter - self._last_rescale_iter)
+            if pct >= self.tolerance:
+                self.loss_scale = max(
+                    self.loss_scale / self.scale_factor, 1.0)
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
             self._unskipped = 0
         else:
             self._unskipped += 1
             if self._unskipped >= self.scale_window:
-                self.loss_scale *= self.scale_factor
+                self.loss_scale = min(self.loss_scale * self.scale_factor,
+                                      self._max_scale)
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
                 self._unskipped = 0
         return self.loss_scale
 

@@ -13,16 +13,23 @@ mxnet_tpu.parallel.DataParallelTrainer, this class's jit-native sibling.
 
 from __future__ import annotations
 
+import logging
+
+from .. import numerics
 from .. import optimizer as opt
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
+_LOG = logging.getLogger("mxnet_tpu.gluon.trainer")
+
+_MAX_SKIP_RECORDS = 1000
+
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, clip_global_norm=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -49,6 +56,14 @@ class Trainer:
         self._update_on_kvstore = None
         self._params_to_init = []
         self._contains_sparse_weight = False
+        # numerical-health guard (mxnet_tpu/numerics.py): clip_global_norm
+        # falls back to MXTPU_CLIP_GLOBAL_NORM when not given; skipped
+        # steps are recorded here (bounded deque-style list)
+        self._clip_global_norm = None if clip_global_norm is None \
+            else float(clip_global_norm)
+        self.divergence_monitor = None
+        self.skipped_steps = []
+        self._step_count = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -107,13 +122,28 @@ class Trainer:
                               "learning rate is mutated.")
         self._optimizer.set_learning_rate(lr)
 
+    def _clip_norm(self):
+        return self._clip_global_norm \
+            if self._clip_global_norm is not None \
+            else numerics.clip_global_norm_env()
+
+    def _set_rescale(self, batch_size):
+        # amp: fold the loss-scaler's unscale into rescale_grad, so the
+        # division happens inside the fused step instead of a separate
+        # pass over the gradients (DynamicLossScaler.unscale returns new
+        # arrays and is only needed on manual paths)
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            self._scale = 1.0 / scaler.loss_scale
+        self._optimizer.rescale_grad = self._scale / batch_size
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce_grads + update (reference: Trainer.step)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        self._set_rescale(batch_size)
+        health = self._allreduce_grads()
+        self._update(ignore_stale_grad, health=health)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -126,25 +156,38 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
+        """Cross-process gradient reduce.  Returns the fused ``(2,)``
+        health array when `bucketed_pushpull` computed it post-reduce
+        (avoiding a second pass over the gradients), else None — the
+        guarded `_update` then runs its own health reduction."""
         if self._kvstore is None:
-            return
+            return None
         if self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param._grad_req != "null":
                     # push grad; pull updated weight (server-side optimizer)
                     self._kvstore.push(i, param.list_grad(), priority=-i)
-            return
+            return None
         keys = [i for i, param in enumerate(self._params)
                 if param._grad_req != "null"]
         if opt.grouped.fused_step_enabled() \
                 and hasattr(self._kvstore, "bucketed_pushpull"):
             grads = [self._params[i].list_grad() for i in keys]
-            self._kvstore.bucketed_pushpull(keys, grads, outs=grads)
-            return
+            bp = self._kvstore.bucketed_pushpull
+            want = numerics.grad_guard_enabled() \
+                or self._clip_norm() is not None
+            code = getattr(getattr(bp, "__func__", bp), "__code__", None)
+            if want and code is not None and "health" in \
+                    code.co_varnames[:code.co_argcount
+                                     + code.co_kwonlyargcount]:
+                return bp(keys, grads, outs=grads, health=True)
+            bp(keys, grads, outs=grads)
+            return None
         for i in keys:
             self._kvstore.pushpull(i, self._params[i].list_grad(),
                                    out=self._params[i].list_grad(),
                                    priority=-i)
+        return None
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -153,10 +196,10 @@ class Trainer:
             "update() when parameters are updated on kvstore is not " \
             "supported. Try setting `update_on_kvstore` to False when " \
             "creating trainer."
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._set_rescale(batch_size)
         self._update(ignore_stale_grad)
 
-    def _update(self, ignore_stale_grad=False):
+    def _update(self, ignore_stale_grad=False, health=None):
         updates = []
         for i, param in enumerate(self._params):
             if param._grad_req == "null":
@@ -171,15 +214,84 @@ class Trainer:
                 self._kvstore.pull(i, param.list_data(), priority=-i)
             else:
                 updates.append((i, param.grad(), param.data()))
+        self._step_count += 1
         if not updates:
             return
         indices, grads, weights = map(list, zip(*updates))
-        if opt.grouped.fused_step_enabled():
+        fused = opt.grouped.fused_step_enabled()
+        guard_on = numerics.grad_guard_enabled()
+        clip = self._clip_norm()
+        if fused and (guard_on or clip is not None):
+            # nan_grad fault site; a fired injection invalidates any
+            # health computed during the allreduce
+            if numerics.maybe_inject_nan_grad(grads) or health is None:
+                health = numerics.grad_health(
+                    [g._data if isinstance(g, NDArray) else g
+                     for g in grads])
+            guard = numerics.StepGuard(health, skip=guard_on, clip=clip)
+            snapshot = self._snapshot_update_counts(indices) \
+                if guard_on else None
+            self._grouped_updaters[0](indices, grads, weights, guard=guard)
+            self._finalize_guarded_step(guard, snapshot)
+        elif fused:
             # one jitted dispatch per (kernel, hyper-params, dtype) group
             self._grouped_updaters[0](indices, grads, weights)
         else:
             for i, g, w in updates:
                 self._updaters[0](i, g, w)
+
+    # -- numerical-health guard plumbing (mxnet_tpu/numerics.py) ---------------
+
+    def _snapshot_update_counts(self, indices):
+        """Host-side optimizer step counters, captured BEFORE the guarded
+        update bumps them — a skipped step must leave Adam's
+        bias-correction `t` (and friends) exactly as if the bad batch
+        never existed."""
+        o = self._optimizer
+        return (o.num_update,
+                {i: o._index_update_count.get(i) for i in indices})
+
+    def _restore_update_counts(self, snapshot):
+        o = self._optimizer
+        num_update, per_index = snapshot
+        o.num_update = num_update
+        for i, v in per_index.items():
+            if v is None:
+                o._index_update_count.pop(i, None)
+            else:
+                o._index_update_count[i] = v
+
+    def _finalize_guarded_step(self, guard, snapshot):
+        """The step's ONE host readback happens here, AFTER the update
+        dispatch, so XLA pipelines the guard with the step.  On an
+        unhealthy step the fused programs already returned the donated
+        weights/states unchanged; this rolls back the host-side step
+        counters, halves the amp loss scale and emits a StepSkipped."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        monitor = self.divergence_monitor
+        if not guard.skip:
+            # clipping-only: no host decision needed unless a monitor or
+            # scaler wants the scalars
+            if monitor is not None:
+                monitor.observe(step=self._step_count,
+                                grad_norm=guard.grad_norm, healthy=True)
+            return
+        healthy = guard.healthy
+        if not healthy:
+            self._restore_update_counts(snapshot)
+            rec = numerics.StepSkipped(
+                step=self._step_count, reason="non-finite gradients",
+                grad_norm=guard.grad_norm,
+                loss_scale=scaler.loss_scale if scaler else None)
+            self.skipped_steps.append(rec)
+            del self.skipped_steps[:-_MAX_SKIP_RECORDS]
+            _LOG.warning("skipped optimizer step: %r", rec)
+        if scaler is not None:
+            scaler.update_scale(not healthy)
+            self._scale = 1.0 / scaler.loss_scale
+        if monitor is not None:
+            monitor.observe(step=self._step_count,
+                            grad_norm=guard.grad_norm, healthy=healthy)
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference: Trainer.save_states)."""

@@ -67,9 +67,11 @@ def _per_process_mesh():
     return Mesh(_np.asarray(devs), ("w",))
 
 
-def _cross_process_allreduce(raw):
+def _cross_process_allreduce(raw, label=None):
     """Eager cross-process all-reduce: each process contributes its local
-    value; the summed result comes back replicated.
+    value; the summed result comes back replicated.  ``label`` names the
+    guarding watchdog (bucketed callers pass dtype + byte size, so a
+    `WatchdogExpired` says WHICH collective wedged).
 
     TPU-native path (SURVEY.md §2.6): per-process contributions become
     shards of a global array on a 1-device-per-process mesh, one jitted
@@ -98,7 +100,7 @@ def _cross_process_allreduce(raw):
     # watchdog around the blocking exchange: a dead peer stalls the
     # all-reduce forever; MXTPU_COLLECTIVE_TIMEOUT turns that into a
     # stack dump + clean error/abort (resilience.py)
-    with resilience.guard_collective("kvstore_allreduce"):
+    with resilience.guard_collective(label or "kvstore_allreduce"):
         garr = multihost_utils.host_local_array_to_global_array(
             jnp.asarray(raw)[None], mesh, PartitionSpec("w"))
         out = fn(garr)
@@ -296,7 +298,8 @@ class KVStore:
         if out is not None:
             self.pull(key, out, priority)
 
-    def bucketed_pushpull(self, keys, values, outs=None, priority=0):
+    def bucketed_pushpull(self, keys, values, outs=None, priority=0,
+                          health=False):
         """Bucketed all-reduce: dense values are flattened and
         concatenated into ~MXTPU_ALLREDUCE_BUCKET_MB (default 4 MB) flat
         buckets per dtype, reduced with ONE collective per bucket, and
@@ -308,7 +311,16 @@ class KVStore:
         `pushpull`: row-sparse values, any active gradient compression
         (its error-feedback residuals are per-key), and server-side
         updaters (the update consumes each key's reduction separately).
+
+        With ``health=True`` a fused ``numerics.grad_health`` reduction
+        runs over the POST-reduce flat buckets (the already-packed
+        arrays — no second pass over the per-key gradients) and the
+        ``(2,)`` ``[all_finite, global_sq_norm]`` device array is
+        returned for the Trainer's numerical-health guard.  Row-sparse
+        fallback keys are not covered (they also bypass the fused
+        optimizer step); returns None when nothing was bucketable.
         """
+        from . import numerics
         from .ndarray.sparse import RowSparseNDArray
 
         if outs is None:
@@ -319,7 +331,13 @@ class KVStore:
                                                 False)):
             for k, v, o in zip(keys, values, outs):
                 self.pushpull(k, v, out=o, priority=priority)
-            return
+            if health:
+                raws = [self._store[k]._data for k in keys
+                        if k in self._store
+                        and not isinstance(self._store[k],
+                                           RowSparseNDArray)]
+                return numerics.grad_health(raws) if raws else None
+            return None
         import jax.numpy as jnp
 
         # local device-list merge per key (the reference's Comm tree),
@@ -339,7 +357,7 @@ class KVStore:
             raw = merged._data if isinstance(merged, NDArray) else merged
             dense.append((k, raw, o))
         if not dense:
-            return
+            return None
         # greedy per-dtype fill up to the bucket byte budget
         budget = _bucket_bytes()
         buckets = []
@@ -356,14 +374,19 @@ class KVStore:
             cur[0].append(item)
             cur[1] += nbytes
         multi = self._is_dist and self.num_workers > 1
-        for _dt, (items, _n) in buckets:
+        reduced_flats = []
+        for dt, (items, nbytes) in buckets:
             with profiler.annotate("bucket_pack"):
                 flat = jnp.concatenate(
                     [raw.reshape(-1) for _, raw, _ in items]) \
                     if len(items) > 1 else items[0][1].reshape(-1)
             if multi:
                 with profiler.annotate("allreduce"):
-                    flat = _cross_process_allreduce(flat)
+                    flat = _cross_process_allreduce(
+                        flat, label=f"kvstore_allreduce[{dt} bucket, "
+                                    f"{nbytes} bytes, {len(items)} keys]")
+            if health:
+                reduced_flats.append(flat)
             offset = 0
             for k, raw, o in items:
                 piece = flat[offset:offset + raw.size].reshape(raw.shape)
@@ -372,6 +395,9 @@ class KVStore:
                 if o is not None:
                     for dst in _as_list(o):
                         dst._set_data(piece)
+        if health and reduced_flats:
+            return numerics.grad_health(reduced_flats)
+        return None
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull ONLY the requested rows as compact row-sparse arrays —

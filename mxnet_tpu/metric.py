@@ -616,18 +616,43 @@ class PearsonCorrelation(EvalMetric):
 @register
 class Loss(EvalMetric):
     """Dummy metric for directly printing loss outputs (reference:
-    mx.metric.Loss)."""
+    mx.metric.Loss).
+
+    Non-finite loss values are EXCLUDED from the running sum — a single
+    NaN would otherwise poison the average for the rest of the epoch
+    (``sum_metric`` can never recover from ``nan + x``).  The excluded
+    count is tracked in ``num_nonfinite`` and warned about once per
+    reset, so divergence stays visible without wrecking the report.
+    """
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
+        self.num_nonfinite = 0
 
     def update(self, _, preds):
         if isinstance(preds, (list, tuple)) is False:
             preds = [preds]
         for pred in preds:
-            loss = float(_to_numpy(pred).sum())
-            self._update(loss, int(numpy.prod(pred.shape)))
+            arr = _to_numpy(pred)
+            finite = numpy.isfinite(arr)
+            if finite.all():
+                self._update(float(arr.sum()), int(arr.size))
+                continue
+            n_bad = int(arr.size - finite.sum())
+            if self.num_nonfinite == 0:
+                import warnings
+
+                warnings.warn(
+                    f"Loss metric '{self.name}': {n_bad} non-finite "
+                    "value(s) excluded from the running sum (see "
+                    "num_nonfinite)", RuntimeWarning, stacklevel=2)
+            self.num_nonfinite += n_bad
+            self._update(float(arr[finite].sum()), int(finite.sum()))
+
+    def reset(self):
+        super().reset()
+        self.num_nonfinite = 0
 
 
 @register

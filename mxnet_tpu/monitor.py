@@ -28,6 +28,18 @@ def _default_stat(x):
     return jnp.abs(x).mean()
 
 
+def nonfinite_fraction(x):
+    """Stat function for NaN-hunting: the fraction of non-finite values
+    in a node's output.  ``Monitor(1, stat_func=monitor.nonfinite_fraction,
+    pattern='.*')`` localizes WHICH layer first produces NaN/Inf when the
+    numerics guard (docs/resilience.md "Numerical resilience") reports
+    skipped steps."""
+    import jax.numpy as jnp
+
+    return 1.0 - jnp.mean(jnp.isfinite(x.astype(jnp.float32))
+                          .astype(jnp.float32))
+
+
 class Monitor:
     """Collect per-node output statistics every ``interval`` batches.
 

@@ -270,28 +270,71 @@ def _groupable(opt, weight, grad):
 _GROUP_FN_CACHE = {}
 
 
-def _group_fn(kernel, static_items):
-    """One cached jit program per (kernel, static hyper-params).  Inside
-    the trace the per-item kernels unroll into a single XLA module;
-    weights (arg 0) and states (arg 2) are donated so the update is
-    in-place on backends that support donation."""
-    key = (kernel, static_items)
+def _group_fn(kernel, static_items, guarded=False, clip=None):
+    """One cached jit program per (kernel, static hyper-params, guard
+    config).  Inside the trace the per-item kernels unroll into a single
+    XLA module; weights (arg 0) and states (arg 2) are donated so the
+    update is in-place on backends that support donation.
+
+    With ``guarded`` the program takes the step's ``(2,)`` health array
+    ``[all_finite, global_sq_norm]`` (numerics.grad_health) and branches
+    on the health predicate with `jax.lax.cond` — an unhealthy step
+    returns the donated inputs bitwise-unchanged, a healthy step runs
+    the update math inside the cond's true branch, which XLA compiles as
+    its own computation scope so fusion/contraction decisions match the
+    unguarded program bitwise (a `jnp.where` over the outputs would pull
+    the select INTO the kernel fusion and perturb FMA contraction).
+    With ``clip`` (a static float) gradients are pre-scaled by
+    ``min(1, clip / (norm + 1e-8))`` — the `gluon.utils.clip_global_norm`
+    formula — inside the same program, reusing the already-computed norm.
+    """
+    key = (kernel, static_items, guarded, clip)
     fn = _GROUP_FN_CACHE.get(key)
     if fn is None:
         import jax
+        import jax.numpy as jnp
 
         static = dict(static_items)
 
-        def group_step(weights, grads, states, dyn):
+        def run_updates(weights, grads, states, dyn, health):
+            coef = None
+            if clip is not None:
+                norm = jnp.sqrt(health[1])
+                coef = jnp.minimum(jnp.float32(1.0),
+                                   jnp.float32(clip) / (norm + 1e-8))
             new_w, new_s = [], []
             for j in range(len(weights)):
                 kw = dict(static)
                 for name, col in dyn.items():
                     kw[name] = col[j]
-                res = kernel(weights[j], grads[j], *states[j], **kw)
+                g = grads[j]
+                if coef is not None:
+                    g = g * coef.astype(g.dtype)
+                res = kernel(weights[j], g, *states[j], **kw)
                 new_w.append(res[0])
                 new_s.append(list(res[1:]))
             return new_w, new_s
+
+        if not guarded and clip is None:
+            def group_step(weights, grads, states, dyn):
+                return run_updates(weights, grads, states, dyn, None)
+        elif not guarded:
+            def group_step(weights, grads, states, dyn, health):
+                return run_updates(weights, grads, states, dyn, health)
+        else:
+            def group_step(weights, grads, states, dyn, health):
+                ok = (health[0] > 0) & jnp.isfinite(health[1])
+
+                def do_step(ops):
+                    return run_updates(*ops)
+
+                def skip_step(ops):
+                    weights, _, states, _, _ = ops
+                    return list(weights), [list(s) for s in states]
+
+                return jax.lax.cond(
+                    ok, do_step, skip_step,
+                    (weights, grads, states, dyn, health))
 
         fn = jax.jit(group_step, donate_argnums=(0, 2))
         _GROUP_FN_CACHE[key] = fn
@@ -322,11 +365,13 @@ class GroupedUpdater:
     def states(self):
         return self._updater.states
 
-    def __call__(self, index, grad, weight):
+    def __call__(self, index, grad, weight, guard=None):
         from .. import profiler
 
         upd = self._updater
         o = upd.optimizer
+        if guard is not None and not guard.skip and guard.clip is None:
+            guard = None  # nothing for the programs to do with it
         if not isinstance(index, (list, tuple)):
             index, grad, weight = [index], [grad], [weight]
         plan = _PLANS.get(type(o))
@@ -346,7 +391,12 @@ class GroupedUpdater:
             static_items = tuple(sorted(static.items()))
             gkey = (kernel, static_items, str(_raw(w).dtype))
             groups.setdefault(gkey, []).append((i, w, g, state_nds, dyn_fn))
-        # legacy per-parameter loop for whatever the kernels can't express
+        # legacy per-parameter loop for whatever the kernels can't express;
+        # guarded steps skip these host-side (the guard's one readback —
+        # shared with the Trainer's finalize via the StepGuard cache)
+        if fallback and guard is not None and guard.skip \
+                and not guard.healthy:
+            fallback = []
         for i, g, w in fallback:
             upd(i, g, w)
         if not groups:
@@ -372,9 +422,16 @@ class GroupedUpdater:
             dyn = {name: _np.asarray([row[name] for row in dyn_rows],
                                      dtype)
                    for name in dyn_rows[0]}
-            fn = _group_fn(kernel, static_items)
-            with profiler.annotate("optimizer_update"):
-                new_w, new_s = fn(w_raws, g_raws, s_raws, dyn)
+            if guard is None:
+                fn = _group_fn(kernel, static_items)
+                with profiler.annotate("optimizer_update"):
+                    new_w, new_s = fn(w_raws, g_raws, s_raws, dyn)
+            else:
+                fn = _group_fn(kernel, static_items,
+                               guarded=guard.skip, clip=guard.clip)
+                with profiler.annotate("optimizer_update"):
+                    new_w, new_s = fn(w_raws, g_raws, s_raws, dyn,
+                                      guard.health)
             _DISPATCH_COUNT += 1
             for (_, w, _, st, _), nw, ns in zip(items, new_w, new_s):
                 w._set_data(nw)

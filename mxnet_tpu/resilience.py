@@ -99,7 +99,11 @@ class _FaultPlan:
             if not item:
                 continue
             site, _, arg = item.partition(":")
-            if site in ("rendezvous", "io_open"):
+            if site in ("rendezvous", "io_open", "nan_grad", "inf_loss"):
+                # nan_grad: poison one gradient with NaN before health
+                # assessment (consumed by the Trainer's numerics guard);
+                # inf_loss: corrupt the loss seen by
+                # numerics.DivergenceMonitor.observe
                 self.counts[site] = int(arg) if arg else 1
             elif site in ("corrupt_record", "sigterm_at_step"):
                 self.args[site] = int(arg) if arg else 0

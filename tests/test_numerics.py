@@ -1,0 +1,594 @@
+"""Numerical-health guard (mxnet_tpu/numerics.py): fused finite-checks,
+skip-step with state rollback, global-norm clipping, divergence
+auto-recovery, loss-scaler fixes, and metric NaN robustness."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, numerics
+from mxnet_tpu.amp import DynamicLossScaler
+from mxnet_tpu.numerics import (DivergenceError, DivergenceMonitor,
+                                StepGuard, StepSkipped)
+from mxnet_tpu.optimizer import grouped
+
+SHAPES = [(5, 7), (3,), (2, 3, 4), (1,), (8, 2), (4, 4)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.get(k)
+             for k in ("MXTPU_FUSED_STEP", "MXTPU_GRAD_GUARD",
+                       "MXTPU_CLIP_GLOBAL_NORM", "MXTPU_MAX_BAD_STEPS")}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _make_params(dtype="float32", seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    params = []
+    for k, shape in enumerate(SHAPES):
+        p = gluon.Parameter(f"p{k}_weight", shape=shape, dtype=dtype)
+        p.initialize(init=mx.init.Zero())
+        p.data()._set_data(
+            jnp.asarray(rng.standard_normal(shape).astype(dtype)))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, grads):
+    import jax.numpy as jnp
+
+    for p, g in zip(params, grads):
+        p.list_grad()[0]._set_data(jnp.asarray(g))
+
+
+def _grad_seq(steps, dtype="float32", seed=1):
+    rng = np.random.RandomState(seed)
+    return [[rng.standard_normal(s).astype(dtype) for s in SHAPES]
+            for _ in range(steps)]
+
+
+def _nan_grads(dtype="float32"):
+    gs = [np.ones(s, dtype) for s in SHAPES]
+    gs[2].flat[3] = np.nan
+    return gs
+
+
+def _flat_state(state):
+    if state is None:
+        return []
+    if isinstance(state, (list, tuple)):
+        return [a for s in state for a in _flat_state(s)]
+    return [state]
+
+
+def _snapshot(trainer, params):
+    weights = [p.data().asnumpy().copy() for p in params]
+    states = {k: [s.asnumpy().copy() for s in _flat_state(v)]
+              for k, v in trainer._updaters[0].states.items()}
+    return weights, states
+
+
+# -- the tentpole: skip-step, one readback, bitwise rollback -------------------
+
+def test_nan_grad_skips_step_bitwise_one_readback():
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    seq = _grad_seq(2)
+    _set_grads(params, seq[0])
+    trainer.step(2, ignore_stale_grad=True)  # healthy: states exist now
+    snap_w, snap_s = _snapshot(trainer, params)
+    num_update = trainer._optimizer.num_update
+    counts = dict(trainer._optimizer._index_update_count)
+
+    _set_grads(params, _nan_grads())
+    numerics.reset_readback_count()
+    grouped.reset_dispatch_count()
+    trainer.step(2, ignore_stale_grad=True)
+
+    # exactly ONE scalar readback and the usual ONE group dispatch
+    assert numerics.readback_count() == 1
+    assert grouped.dispatch_count() == 1
+    # weights and optimizer state bitwise-unchanged
+    for p, w0 in zip(params, snap_w):
+        np.testing.assert_array_equal(p.data().asnumpy(), w0)
+    for k, v in trainer._updaters[0].states.items():
+        for s, s0 in zip(_flat_state(v), snap_s[k]):
+            np.testing.assert_array_equal(s.asnumpy(), s0)
+    # host-side step counters rolled back (Adam bias-correction t)
+    assert trainer._optimizer.num_update == num_update
+    assert dict(trainer._optimizer._index_update_count) == counts
+    # the skip was recorded
+    assert len(trainer.skipped_steps) == 1
+    rec = trainer.skipped_steps[0]
+    assert isinstance(rec, StepSkipped)
+    assert math.isnan(rec.grad_norm)
+    assert "non-finite" in rec.reason
+
+
+def test_healthy_steps_one_readback_each():
+    params = _make_params()
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                            kvstore=None)
+    seq = _grad_seq(3)
+    numerics.reset_readback_count()
+    for g in seq:
+        _set_grads(params, g)
+        trainer.step(2, ignore_stale_grad=True)
+    assert numerics.readback_count() == len(seq)
+    assert not trainer.skipped_steps
+
+
+def test_skipped_step_trajectory_as_if_batch_dropped():
+    """[g0, NaN, g1] must land bitwise where [g0, g1] lands — the skipped
+    step leaves NO trace (weights, states, or step counts)."""
+    seq = _grad_seq(2)
+
+    def run(with_nan):
+        params = _make_params()
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        gs = [seq[0]] + ([_nan_grads()] if with_nan else []) + [seq[1]]
+        for g in gs:
+            _set_grads(params, g)
+            trainer.step(2, ignore_stale_grad=True)
+        return _snapshot(trainer, params)
+
+    w_clean, s_clean = run(False)
+    w_nan, s_nan = run(True)
+    for a, b in zip(w_clean, w_nan):
+        np.testing.assert_array_equal(a, b)
+    for k in s_clean:
+        for a, b in zip(s_clean[k], s_nan[k]):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("optname,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4}),
+    ("lamb", {"learning_rate": 1e-3}),
+    ("ftml", {"learning_rate": 1e-3}),
+])
+def test_guard_on_off_bitwise_identical(optname, kwargs):
+    """Healthy steps with the guard ON are bitwise-identical to guard
+    OFF (the lax.cond true branch compiles like the unguarded program)."""
+    seq = _grad_seq(4)
+
+    def run(guard):
+        os.environ["MXTPU_GRAD_GUARD"] = "1" if guard else "0"
+        params = _make_params()
+        trainer = gluon.Trainer(params, optname, dict(kwargs),
+                                kvstore=None)
+        for g in seq:
+            _set_grads(params, g)
+            trainer.step(2, ignore_stale_grad=True)
+        return [p.data().asnumpy() for p in params]
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_guard_off_no_readbacks_no_skip():
+    os.environ["MXTPU_GRAD_GUARD"] = "0"
+    params = _make_params()
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=None)
+    numerics.reset_readback_count()
+    _set_grads(params, _nan_grads())
+    trainer.step(2, ignore_stale_grad=True)
+    assert numerics.readback_count() == 0
+    assert not trainer.skipped_steps
+    # with the guard off the NaN really does poison the weights
+    assert not np.isfinite(params[2].data().asnumpy()).all()
+
+
+def test_fallback_items_host_skipped():
+    """Non-groupable items (fp16 multi-precision master weights) take the
+    legacy loop — a guarded unhealthy step must skip them too."""
+    params = _make_params(dtype="float16")
+    trainer = gluon.Trainer(
+        params, "sgd",
+        {"learning_rate": 0.1, "multi_precision": True}, kvstore=None)
+    _set_grads(params, _grad_seq(1, dtype="float16")[0])
+    trainer.step(2, ignore_stale_grad=True)
+    snap_w, _ = _snapshot(trainer, params)
+    _set_grads(params, _nan_grads(dtype="float16"))
+    trainer.step(2, ignore_stale_grad=True)
+    for p, w0 in zip(params, snap_w):
+        np.testing.assert_array_equal(p.data().asnumpy(), w0)
+    assert trainer.skipped_steps
+
+
+# -- global-norm clipping ------------------------------------------------------
+
+def _run_clipped(clip_arg=None, env=None, manual=False, steps=3):
+    from mxnet_tpu.gluon.utils import clip_global_norm
+
+    if env is not None:
+        os.environ["MXTPU_CLIP_GLOBAL_NORM"] = str(env)
+    seq = _grad_seq(steps, seed=3)
+    params = _make_params()
+    kw = {"clip_global_norm": clip_arg} if clip_arg is not None else {}
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-2},
+                            kvstore=None, **kw)
+    for g in seq:
+        _set_grads(params, g)
+        if manual:
+            clip_global_norm([p.grad() for p in params], manual)
+        trainer.step(2, ignore_stale_grad=True)
+    return [p.data().asnumpy() for p in params]
+
+
+def test_clip_global_norm_matches_reference():
+    """The fused in-program clip reproduces gluon.utils.clip_global_norm
+    applied eagerly before an unclipped step."""
+    fused = _run_clipped(clip_arg=0.05)
+    os.environ.pop("MXTPU_CLIP_GLOBAL_NORM", None)
+    manual = _run_clipped(manual=0.05)
+    for a, b in zip(fused, manual):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+def test_clip_global_norm_env_var():
+    a = _run_clipped(clip_arg=0.05)
+    b = _run_clipped(env=0.05)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_clip_works_with_guard_off():
+    os.environ["MXTPU_GRAD_GUARD"] = "0"
+    clipped = _run_clipped(clip_arg=0.05)
+    os.environ["MXTPU_GRAD_GUARD"] = "1"
+    ref = _run_clipped(clip_arg=0.05)
+    for a, b in zip(clipped, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_clip_no_op_above_norm():
+    """A huge threshold never rescales: bitwise-identical to no clip."""
+    plain = _run_clipped()
+    clipped = _run_clipped(clip_arg=1e9)
+    for a, b in zip(plain, clipped):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- bucketed_pushpull health + watchdog labels --------------------------------
+
+def test_bucketed_pushpull_returns_health():
+    import jax.numpy as jnp
+
+    kv = mx.kvstore.create("device")
+    vals = [mx.nd.array(np.ones((4, 4), np.float32)),
+            mx.nd.array(np.full((8,), 2.0, np.float32))]
+    for k, v in enumerate(vals):
+        kv.init(k, v)
+    outs = [mx.nd.zeros_like(v) for v in vals]
+    health = kv.bucketed_pushpull([0, 1], vals, outs=outs, health=True)
+    h = np.asarray(health)
+    assert h[0] == 1.0
+    np.testing.assert_allclose(h[1], 16.0 + 8 * 4.0)
+    # poisoned value flips the finite flag
+    bad = [mx.nd.array(np.full((4, 4), np.nan, np.float32)), vals[1]]
+    health = kv.bucketed_pushpull([0, 1], bad, outs=None, health=True)
+    assert np.asarray(health)[0] == 0.0
+    # health=False keeps the legacy None contract
+    assert kv.bucketed_pushpull([0, 1], vals, outs=outs) is None
+
+
+def test_trainer_spy_kvstore_without_health_kwarg():
+    """A monkeypatched/legacy bucketed_pushpull without the health kwarg
+    must still work — the Trainer computes health itself."""
+    params = _make_params()
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore="device")
+    trainer._init_kvstore()
+
+    class SpyKV:
+        type = "device"
+        num_workers = 1
+        calls = []
+
+        def bucketed_pushpull(self, keys, values, outs=None, priority=0):
+            self.calls.append(list(keys))
+
+    trainer._kvstore = SpyKV()
+    trainer._update_on_kvstore = False
+    _set_grads(params, _nan_grads())
+    trainer.step(2, ignore_stale_grad=True)
+    assert trainer._kvstore.calls  # the reduce ran without health=
+    assert trainer.skipped_steps   # and the guard still caught the NaN
+
+
+@pytest.mark.faults
+def test_watchdog_message_names_bucket(fault_inject, monkeypatch):
+    """A wedged bucketed all-reduce must say WHICH bucket: dtype and
+    byte size in the WatchdogExpired message."""
+    from mxnet_tpu import kvstore as kvmod
+    from mxnet_tpu.resilience import WatchdogExpired
+
+    monkeypatch.setenv("MXTPU_COLLECTIVE_TIMEOUT", "0.5")
+    kv = mx.kvstore.create("device")
+    v = mx.nd.array(np.ones((4, 4), np.float32))
+    kv.init(0, v)
+    # single-process stores never hit the collective; force the dist
+    # branch so _cross_process_allreduce (and its watchdog) runs
+    monkeypatch.setattr(kv, "_is_dist", True, raising=False)
+    monkeypatch.setattr(type(kv), "num_workers",
+                        property(lambda self: 2), raising=False)
+    fault_inject("stall_collective:30")
+    with pytest.raises(WatchdogExpired) as ei:
+        kv.bucketed_pushpull([0], [v], outs=None)
+    msg = str(ei.value)
+    assert "float32" in msg
+    assert "64 bytes" in msg
+
+
+# -- fault-injection sites -----------------------------------------------------
+
+@pytest.mark.faults
+def test_nan_grad_fault_site_skips_and_recovers(fault_inject):
+    """Inject a NaN batch mid-run: the step is skipped and the
+    post-recovery loss/weight trajectory is IDENTICAL to a run that
+    never saw the poisoned batch."""
+    seq = _grad_seq(4, seed=9)
+
+    def run(poison_at=None):
+        params = _make_params()
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3},
+                                kvstore=None)
+        traj = []
+        for i, g in enumerate(seq):
+            if i == poison_at:
+                fault_inject("nan_grad:1")
+            _set_grads(params, g)
+            trainer.step(2, ignore_stale_grad=True)
+            traj.append([p.data().asnumpy().copy() for p in params])
+        return trainer, traj
+
+    clean_tr, clean = run()
+    assert not clean_tr.skipped_steps
+    pois_tr, pois = run(poison_at=2)
+    assert len(pois_tr.skipped_steps) == 1
+    # the poisoned step left weights exactly at the previous step's
+    np.testing.assert_array_equal(pois[2][0], pois[1][0])
+    # post-recovery trajectory identical to the run that skipped batch 2
+    # (same grads applied to the same weights — the NaN left no trace,
+    # but step 3 consumed grad 3 in both runs, so compare weight deltas)
+    for a, b in zip(clean[0], pois[0]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(clean[1], pois[1]):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.faults
+def test_inf_loss_fault_site(fault_inject):
+    mon = DivergenceMonitor(max_bad_steps=3)
+    fault_inject("inf_loss:1")
+    assert not mon.observe(step=0, loss=1.0)
+    assert mon.bad_streak == 1  # the injected inf made step 0 bad
+    assert not mon.observe(step=1, loss=1.0)
+    assert mon.bad_streak == 0
+
+
+# -- DynamicLossScaler satellites ----------------------------------------------
+
+def test_scaler_unscale_returns_new_arrays():
+    s = DynamicLossScaler(init_scale=8.0)
+    g = mx.nd.array(np.full((3,), 16.0, np.float32))
+    out = s.unscale([g])
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((3,), 2.0))
+    # the input is NOT mutated (JAX arrays are immutable)
+    np.testing.assert_allclose(g.asnumpy(), np.full((3,), 16.0))
+
+
+def test_scaler_growth_capped():
+    s = DynamicLossScaler(init_scale=2.0 ** 16, scale_window=1)
+    for _ in range(10):
+        s.update_scale(False)
+    assert s.loss_scale == 2.0 ** 16  # capped at the init/2^16 ceiling
+    s2 = DynamicLossScaler(init_scale=2.0 ** 20, scale_window=1)
+    for _ in range(10):
+        s2.update_scale(False)
+    assert s2.loss_scale == 2.0 ** 20  # a larger init raises the ceiling
+
+
+def test_scaler_tolerance_honored():
+    # tolerance=0.5: a lone overflow in a long clean stretch (rate
+    # 1/N < 0.5) must NOT halve the scale
+    s = DynamicLossScaler(init_scale=1024.0, scale_window=100,
+                          tolerance=0.5)
+    for _ in range(9):
+        s.update_scale(False)
+    s.update_scale(True)
+    assert s.loss_scale == 1024.0
+    # an overflow-dominated stretch crosses the tolerance -> halve
+    s2 = DynamicLossScaler(init_scale=1024.0, scale_window=100,
+                           tolerance=0.5)
+    s2.update_scale(False)
+    s2.update_scale(True)  # rate 1/2 >= 0.5
+    assert s2.loss_scale == 512.0
+    # default tolerance=0.0 preserves the classic always-halve
+    s0 = DynamicLossScaler(init_scale=1024.0)
+    assert s0.update_scale(True) == 512.0
+
+
+def test_scaler_has_overflow_single_readback():
+    s = DynamicLossScaler()
+    good = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(5)]
+    bad = good + [mx.nd.array(np.array([np.inf], np.float32))]
+    numerics.reset_readback_count()
+    assert not s.has_overflow(good)
+    assert numerics.readback_count() == 1
+    assert s.has_overflow(bad)
+    assert numerics.readback_count() == 2
+
+
+def test_trainer_amp_scaler_integration():
+    """A NaN step under an attached loss scaler halves the scale and the
+    next step's rescale_grad reflects it (unscale folded into the fused
+    step)."""
+    params = _make_params()
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=None)
+    trainer._amp_loss_scaler = DynamicLossScaler(init_scale=1024.0)
+    _set_grads(params, _nan_grads())
+    trainer.step(2, ignore_stale_grad=True)
+    assert trainer._amp_loss_scaler.loss_scale == 512.0
+    assert trainer.skipped_steps[0].loss_scale == 1024.0
+    _set_grads(params, _grad_seq(1)[0])
+    trainer.step(2, ignore_stale_grad=True)
+    assert trainer._optimizer.rescale_grad == (1.0 / 512.0) / 2
+
+
+# -- DivergenceMonitor ---------------------------------------------------------
+
+def test_divergence_monitor_rolls_back(tmp_path):
+    from mxnet_tpu.resilience import LocalCheckpointer
+
+    ck = LocalCheckpointer(tmp_path)
+    ck.save(7, {"w": np.arange(4.0)})
+    restored = {}
+    scaler = DynamicLossScaler(init_scale=1024.0)
+    mon = DivergenceMonitor(checkpointer=ck, set_state=restored.update,
+                            scaler=scaler, max_bad_steps=3)
+    for i in range(2):
+        assert not mon.observe(step=i, loss=float("nan"),
+                               batch_indices=[i])
+    assert mon.observe(step=2, loss=float("nan"), batch_indices=[2])
+    np.testing.assert_array_equal(restored["w"], np.arange(4.0))
+    assert mon.recoveries == 1
+    assert mon.quarantined == [0, 1, 2]
+    assert scaler.loss_scale == 512.0  # re-seeded
+    assert mon.bad_streak == 0
+
+
+def test_divergence_monitor_explosion_detection():
+    mon = DivergenceMonitor(max_bad_steps=100, explode_factor=8.0)
+    for i in range(20):
+        mon.observe(step=i, loss=1.0, grad_norm=1.0)
+    assert mon.bad_streak == 0
+    mon.observe(step=20, loss=1.0, grad_norm=100.0)  # 100x the EWMA
+    assert mon.bad_streak == 1
+    mon.observe(step=21, loss=50.0, grad_norm=1.0)  # loss explosion
+    assert mon.bad_streak == 2
+
+
+def test_divergence_error_without_checkpointer():
+    mon = DivergenceMonitor(max_bad_steps=2)
+    mon.observe(step=0, loss=float("inf"), batch_indices=[10])
+    with pytest.raises(DivergenceError) as ei:
+        mon.observe(step=1, loss=float("inf"), batch_indices=[11])
+    assert ei.value.bad_steps == 2
+    assert ei.value.batch_indices == [10, 11]
+    assert "diverged" in str(ei.value)
+
+
+def test_divergence_monitor_env_default(monkeypatch):
+    monkeypatch.setenv("MXTPU_MAX_BAD_STEPS", "7")
+    assert DivergenceMonitor().max_bad_steps == 7
+
+
+def test_trainer_divergence_monitor_attached():
+    params = _make_params()
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=None)
+    mon = DivergenceMonitor(max_bad_steps=50)
+    trainer.divergence_monitor = mon
+    _set_grads(params, _grad_seq(1)[0])
+    trainer.step(2, ignore_stale_grad=True)
+    assert mon.norm_ewma is not None and mon.norm_ewma > 0
+    _set_grads(params, _nan_grads())
+    trainer.step(2, ignore_stale_grad=True)
+    assert mon.bad_streak == 1
+
+
+# -- metric NaN robustness -----------------------------------------------------
+
+def test_loss_metric_excludes_nonfinite():
+    m = mx.metric.Loss()
+    m.update(None, [mx.nd.array(np.array([1.0, 2.0], np.float32))])
+    with pytest.warns(RuntimeWarning):
+        m.update(None, [mx.nd.array(
+            np.array([np.nan, 4.0, np.inf], np.float32))])
+    name, val = m.get()
+    assert math.isfinite(val)
+    np.testing.assert_allclose(val, (1.0 + 2.0 + 4.0) / 3)
+    assert m.num_nonfinite == 2
+    m.reset()
+    assert m.num_nonfinite == 0
+
+
+@pytest.mark.parametrize("metric_fn", [
+    lambda: mx.metric.Accuracy(),
+    lambda: mx.metric.TopKAccuracy(top_k=2),
+])
+def test_accuracy_metrics_not_poisoned_by_nan(metric_fn):
+    """NaN/Inf prediction rows contribute WRONG (finite) counts, never
+    NaN sums — the running accuracy stays a real number."""
+    m = metric_fn()
+    labels = mx.nd.array(np.array([0, 1, 2, 1], np.float32))
+    preds = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    preds[1] = np.nan
+    preds[3] = np.inf
+    m.update([labels], [mx.nd.array(preds)])
+    _, val = m.get()
+    assert math.isfinite(val)
+    assert 0.0 <= val <= 1.0
+
+
+# -- guard internals -----------------------------------------------------------
+
+def test_grad_health_values():
+    import jax.numpy as jnp
+
+    h = np.asarray(numerics.grad_health(
+        [jnp.ones((2, 2), jnp.float32), jnp.full((3,), 2.0, jnp.float32)]))
+    assert h[0] == 1.0
+    np.testing.assert_allclose(h[1], 4.0 + 12.0)
+    h = np.asarray(numerics.grad_health(
+        [jnp.array([np.inf], jnp.float32)]))
+    assert h[0] == 0.0
+
+
+def test_grad_health_f16_overflow_detected():
+    """An f16 inf survives the f32 accumulation upcast."""
+    import jax.numpy as jnp
+
+    g = StepGuard(numerics.grad_health(
+        [jnp.array([np.inf, 1.0], jnp.float16)]))
+    assert not g.healthy
+
+
+def test_combine_health():
+    import jax.numpy as jnp
+
+    parts = [numerics.grad_health([jnp.ones((2,), jnp.float32)]),
+             numerics.grad_health([jnp.full((3,), 2.0, jnp.float32)])]
+    h = np.asarray(numerics.combine_health(parts))
+    assert h[0] == 1.0
+    np.testing.assert_allclose(h[1], 2.0 + 12.0)
+    bad = [parts[0],
+           numerics.grad_health([jnp.array([np.nan], jnp.float32)])]
+    assert np.asarray(numerics.combine_health(bad))[0] == 0.0
+
+
+def test_step_guard_caches_single_readback():
+    import jax.numpy as jnp
+
+    g = StepGuard(numerics.grad_health([jnp.ones((4,), jnp.float32)]))
+    numerics.reset_readback_count()
+    assert g.healthy
+    assert g.grad_norm == 2.0
+    assert numerics.readback_count() == 1  # both reads share one sync
