@@ -193,6 +193,18 @@ def _pipeline_attempts():
     ]
 
 
+def _ckpt_attempts():
+    # pure host work (snapshot + pickle + fsync): always CPU, no probe
+    return [
+        ({"JAX_PLATFORMS": "cpu"},
+         {"model": "ckpt",
+          "mb": int(os.environ.get("BENCH_CKPT_MB", 64)),
+          "reps": int(os.environ.get("BENCH_CKPT_REPS", 5)),
+          "batch": 0,
+          "backend": "cpu"}, 300),
+    ]
+
+
 def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
@@ -272,6 +284,13 @@ def orchestrate():
             pipe = _run_worker(env_over, cfg, budget, pipe_errors)
             if pipe is not None:
                 break
+    ckpt = None
+    ckpt_errors = []
+    if headline is not None and not os.environ.get("BENCH_SKIP_CKPT"):
+        for env_over, cfg, budget in _ckpt_attempts():
+            ckpt = _run_worker(env_over, cfg, budget, ckpt_errors)
+            if ckpt is not None:
+                break
     if headline is None:
         print(json.dumps({
             "metric": "resnet50_train_samples_per_sec_per_chip",
@@ -314,6 +333,14 @@ def orchestrate():
             pipe.get("stall_share_sync")
     elif pipe_errors:
         headline["input_pipeline_error"] = "; ".join(pipe_errors)[-300:]
+    if ckpt is not None:
+        headline["ckpt_stall_us"] = ckpt["value"]
+        headline["ckpt_stall_us_sync"] = ckpt.get("sync_stall_us")
+        headline["ckpt_stall_speedup"] = ckpt.get("speedup")
+        headline["ckpt_async_commit_ms"] = ckpt.get("async_commit_ms")
+        headline["ckpt_state_mb"] = ckpt.get("state_mb")
+    elif ckpt_errors:
+        headline["ckpt_error"] = "; ".join(ckpt_errors)[-300:]
     print(json.dumps(headline))
     return 0
 
@@ -451,6 +478,8 @@ def worker(cfg):
         bench_trainer(cfg, devices)
     elif cfg["model"] == "input_pipeline":
         bench_input_pipeline(cfg, devices)
+    elif cfg["model"] == "ckpt":
+        bench_ckpt(cfg, devices)
     else:
         bench_resnet(cfg, devices)
 
@@ -662,6 +691,66 @@ def bench_input_pipeline(cfg, devices):
         "stall_share_prefetch": round(stall_pf, 3),
         "stall_share_sync": round(stall_sync, 3),
         "n": n, "batch": batch, "image": size, "workers": workers,
+        "backend": devices[0].platform,
+    }))
+
+
+def bench_ckpt(cfg, devices):
+    """ckpt_stall_us: train-thread stall per checkpoint save() — how long
+    ``save()`` blocks the caller before training can continue.  'async'
+    is the native AsyncCheckpointer (copy-on-snapshot, then a background
+    writer serializes/fsyncs/commits); 'sync' is the SAME engine with
+    ``async_save=False`` (the whole pickle+fsync+commit inline).  Same
+    ~cfg['mb'] MB state and directory layout for both, so the delta is
+    exactly the work moved off the critical path.  ``async_commit_ms``
+    (save->wait latency) is reported for context: the stall win is only
+    real while the commit also finishes well inside a checkpoint
+    interval."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import checkpoint
+
+    mb, reps = cfg["mb"], cfg["reps"]
+    n_arr = 8
+    per = max(1, (mb << 20) // (4 * n_arr))
+    state = {"params": [np.random.RandomState(i).rand(per)
+                        .astype(np.float32) for i in range(n_arr)],
+             "step": 0}
+
+    def run(async_save):
+        d = tempfile.mkdtemp(prefix="bench_ckpt_")
+        ck = checkpoint.AsyncCheckpointer(
+            d, max_to_keep=2, async_save=async_save, rank=0,
+            world_size=1)
+        ck.save(0, state)    # warm: page cache, allocator, thread path
+        ck.wait()
+        stalls, commit = [], 0.0
+        for r in range(1, reps + 1):
+            t0 = time.perf_counter()
+            ck.save(r, state)
+            stalls.append(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            ck.wait()
+            commit += time.perf_counter() - t1
+        shutil.rmtree(d, ignore_errors=True)
+        return (1e6 * sorted(stalls)[len(stalls) // 2],   # median us
+                1e3 * commit / reps)                      # mean ms
+
+    async_us, commit_ms = run(True)
+    sync_us, _ = run(False)
+
+    print(json.dumps({
+        "metric": "ckpt_stall_us",
+        "value": round(async_us, 1),
+        "unit": "us/save",
+        "vs_baseline": None,
+        "sync_stall_us": round(sync_us, 1),
+        "speedup": round(sync_us / async_us, 2) if async_us else None,
+        "async_commit_ms": round(commit_ms, 1),
+        "state_mb": mb, "reps": reps,
         "backend": devices[0].platform,
     }))
 

@@ -89,6 +89,9 @@ def main():
                         help="poison this step's gradients with NaN "
                              "instead of the SIGTERM demo (numerical-"
                              "health guard)")
+    parser.add_argument("--sync-ckpt", action="store_true",
+                        help="synchronous saves (default: the native "
+                             "async snapshot-and-commit engine)")
     args = parser.parse_args()
 
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="resilient_ckpt_")
@@ -102,7 +105,12 @@ def main():
 
     step_fn, get_state, set_state, trainer = build(
         args.batch_size, nan_step=args.nan_step)
-    ck = resilience.LocalCheckpointer(ckpt_dir, max_to_keep=3)
+    # make_checkpointer picks the engine: the native async snapshot-and-
+    # commit engine by default (crash-atomic two-phase commit, saves off
+    # the training thread); --sync-ckpt forces synchronous saves
+    ck = mx.checkpoint.make_checkpointer(
+        ckpt_dir, max_to_keep=3,
+        async_save=False if args.sync_ckpt else None)
     if args.nan_step is not None:
         # divergence watchdog: rolls back to the last snapshot if the
         # run ever goes unhealthy for MXTPU_MAX_BAD_STEPS in a row

@@ -113,6 +113,12 @@ def barrier(name="mxtpu_barrier"):
     dump and a clean error/abort instead of an infinite hang; the
     barrier's own RPC deadline (2x the watchdog, 1800s unguarded) is the
     defense-in-depth behind it.
+
+    This is also the sync point of checkpoint.AsyncCheckpointer's
+    two-phase commit ("ckpt_shards_<step>" — every shard durable before
+    rank 0 renames the manifest — and "ckpt_commit_<step>"), so a rank
+    that dies mid-checkpoint surfaces here, as a watchdog abort, rather
+    than as a torn checkpoint.
     """
     global _BARRIER_N
     with resilience.guard_collective(f"barrier:{name}"):

@@ -46,8 +46,15 @@ def wait_all() -> None:
 
     # PJRT exposes no global barrier; syncing every live array is the
     # equivalent drain.  jax.live_arrays() covers everything dispatched.
+    # Donated buffers (the fused trainer step's inputs) stay in the live
+    # list until GC but cannot be blocked on — skip them.
     for a in jax.live_arrays():
-        a.block_until_ready()
+        try:
+            if a.is_deleted():
+                continue
+            a.block_until_ready()
+        except RuntimeError:
+            continue   # deleted between the check and the block
 
 
 def bulk(size: int | None = None):

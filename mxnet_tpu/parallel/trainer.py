@@ -411,6 +411,16 @@ class ShardedTrainer:
 
         checkpoint.load_trainer_state(self, state)
 
+    def state_template(self):
+        """Elastic-restore template: `state_dict()`'s structure with this
+        trainer's shardings at every array position.  Pass it to
+        ``checkpoint.AsyncCheckpointer.restore(step, template=...)`` to
+        re-lay a checkpoint written under a different world size or mesh
+        onto this trainer's layout."""
+        from .. import checkpoint
+
+        return checkpoint.trainer_state_template(self)
+
     def sync_params(self):
         """Write the mesh-resident values back into the gluon Parameters
         (handle swap, no host transfer)."""

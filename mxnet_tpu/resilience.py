@@ -51,6 +51,12 @@ Fault-injection sites (``MXTPU_FAULT_INJECT="site:arg,site:arg"``):
                           (honored by :func:`run_resilient`)
 - ``stall_collective[:SECS]`` — stall inside the next guarded collective
                           (default 3600s — the watchdog must fire first)
+- ``crash_during_save``  — hard-kill the process mid-shard-write (the
+                          async checkpoint engine, checkpoint.py)
+- ``crash_before_manifest`` — hard-kill after all shards are written but
+                          before the manifest commit rename
+- ``corrupt_shard:K``    — flip bytes in shard K of the checkpoint that
+                          was just committed
 """
 
 from __future__ import annotations
@@ -99,13 +105,15 @@ class _FaultPlan:
             if not item:
                 continue
             site, _, arg = item.partition(":")
-            if site in ("rendezvous", "io_open", "nan_grad", "inf_loss"):
+            if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
+                        "crash_during_save", "crash_before_manifest"):
                 # nan_grad: poison one gradient with NaN before health
                 # assessment (consumed by the Trainer's numerics guard);
                 # inf_loss: corrupt the loss seen by
                 # numerics.DivergenceMonitor.observe
                 self.counts[site] = int(arg) if arg else 1
-            elif site in ("corrupt_record", "sigterm_at_step"):
+            elif site in ("corrupt_record", "sigterm_at_step",
+                          "corrupt_shard"):
                 self.args[site] = int(arg) if arg else 0
                 self.counts[site] = 1
             elif site in ("stall_collective", "stall"):
@@ -173,6 +181,22 @@ def consume_fault(site):
     return plan is not None and plan.consume(site)
 
 
+#: exit code of an injected hard crash (``crash_during_save`` /
+#: ``crash_before_manifest``) — distinct from the watchdog's 124 so the
+#: crash-consistency tests can assert WHICH kill fired.
+CRASH_EXIT_CODE = 57
+
+
+def maybe_crash(site):
+    """Injected hard crash: ``os._exit`` with no cleanup, no atexit, no
+    flush — the closest a test can get to power loss / OOM-kill."""
+    plan = _plan()
+    if plan is not None and plan.consume(site):
+        sys.stderr.write(f"[resilience] injected crash at {site}\n")
+        sys.stderr.flush()
+        os._exit(CRASH_EXIT_CODE)
+
+
 def maybe_stall(site="stall_collective"):
     """Injected stall: sleep in small interruptible increments so an
     'interrupt' watchdog can break the stall (a real wedged C collective
@@ -184,6 +208,29 @@ def maybe_stall(site="stall_collective"):
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
         time.sleep(0.05)
+
+
+# -- durable IO ----------------------------------------------------------------
+
+def fsync_dir(path):
+    """fsync a DIRECTORY so a just-renamed entry survives power loss.
+
+    ``os.replace`` makes a write atomic but not durable: the rename
+    itself lives in the directory inode, which ``fsync`` of the data
+    file never touches.  Both checkpointers call this after every
+    rename-commit.  Filesystems that refuse directory fds (some network
+    mounts) are tolerated — they journal renames themselves.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 # -- retry primitive -----------------------------------------------------------
@@ -418,6 +465,15 @@ def guard_step(name="train_step"):
         yield
 
 
+@contextlib.contextmanager
+def guard_checkpoint(name="checkpoint"):
+    """Guard a checkpoint save/restore (MXTPU_CKPT_TIMEOUT, unset = off):
+    a hung filesystem dumps every thread's stack instead of wedging the
+    run silently."""
+    with _env_watchdog("MXTPU_CKPT_TIMEOUT", name):
+        yield
+
+
 # -- local checkpointer --------------------------------------------------------
 
 _CKPT_MAGIC = b"MXTCKPT1"
@@ -468,12 +524,16 @@ class LocalCheckpointer:
         header = _CKPT_MAGIC + struct.pack(
             "<IQ", zlib.crc32(payload) & 0xffffffff, len(payload))
         tmp = self._path(step) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(header)
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(step))
+        with guard_checkpoint(f"ckpt_save:{step}"):
+            with open(tmp, "wb") as f:
+                f.write(header)
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(step))
+            # durability: the rename lives in the directory inode — fsync
+            # it too, or power loss can roll the commit back
+            fsync_dir(self._dir)
         self._prune()
         return step
 
@@ -491,8 +551,13 @@ class LocalCheckpointer:
             if step is None:
                 raise MXNetError(f"no checkpoints under {self._dir}")
         path = self._path(step)
-        with open(path, "rb") as f:
-            blob = f.read()
+
+        def read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        with guard_checkpoint(f"ckpt_restore:{step}"):
+            blob = io_retry(read, description=f"read {path}")
         if len(blob) < len(_CKPT_MAGIC) + 12 or \
                 not blob.startswith(_CKPT_MAGIC):
             raise CheckpointCorrupt(f"{path}: bad checkpoint magic")
@@ -553,9 +618,30 @@ class RunReport:
                 f"preempted={self.preempted})")
 
 
+def flush_inflight(checkpointer, logger=None):
+    """Drain an async checkpointer's in-flight save at a recovery point.
+
+    A failed background commit must not abort recovery — the previous
+    checkpoint is still valid, which is the whole point of the two-phase
+    commit — so errors are logged and swallowed here (they would have
+    been raised from the next ``save()`` anyway).
+    """
+    wait = getattr(checkpointer, "wait", None)
+    if wait is None:
+        return
+    try:
+        wait()
+    except Exception as e:                      # noqa: BLE001
+        _log(logger, f"in-flight checkpoint save failed ({e}); "
+                     f"recovering from the previous checkpoint")
+
+
 def resume_latest(checkpointer, set_state, logger=None):
     """Restore the newest VALID checkpoint; corrupt/partial ones fall
-    back to the previous step.  Returns the restored step (0 = fresh)."""
+    back to the previous step.  Returns the restored step (0 = fresh).
+    Any in-flight async save is drained first so a commit racing the
+    restore can't be half-observed."""
+    flush_inflight(checkpointer, logger)
     steps = sorted(checkpointer.all_steps(), reverse=True) \
         if hasattr(checkpointer, "all_steps") else \
         ([checkpointer.latest_step()]
@@ -599,7 +685,7 @@ def _save_verified(checkpointer, step, state, logger=None):
 
 
 def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
-                  set_state, checkpoint_every=25, max_restarts=3,
+                  set_state, checkpoint_every=None, max_restarts=3,
                   watchdog_timeout=None, exit_on_preempt=False,
                   recover_on=(RuntimeError, OSError), logger=None):
     """Supervised training loop: auto-resume + preemption checkpointing +
@@ -610,7 +696,13 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
       training state for crash-resume to reproduce the loss trajectory.
     - ``get_state() -> pytree`` / ``set_state(pytree)``: snapshot/load
       everything a restart needs (params, optimizer state, RNG, ...).
-    - ``checkpointer``: LocalCheckpointer / ShardedCheckpointer surface.
+    - ``checkpointer``: LocalCheckpointer / checkpoint.AsyncCheckpointer /
+      ShardedCheckpointer surface.  An async engine overlaps the
+      serialize+fsync with training (its CRC-verified two-phase commit
+      replaces the synchronous verify-after-write) and is drained at
+      every recovery point and at the end of the run.
+    - ``checkpoint_every``: steps between periodic saves; ``None`` reads
+      ``MXTPU_CKPT_EVERY`` (default 25), ``0`` disables.
     - On SIGTERM (TPU preemption notice) the current state is
       checkpointed; with ``exit_on_preempt`` the driver returns (the
       process is about to die), otherwise the preemption is treated as
@@ -623,6 +715,19 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
     Returns a :class:`RunReport`.
     """
     from .checkpoint import PreemptionHandler
+
+    if checkpoint_every is None:
+        checkpoint_every = int(os.environ.get("MXTPU_CKPT_EVERY", 25))
+    # async engines own crash consistency via the two-phase commit; the
+    # synchronous readback verify would serialize the save we just made
+    # asynchronous
+    is_async = bool(getattr(checkpointer, "async_save", False))
+
+    def save_at(step):
+        if is_async:
+            checkpointer.save(step, get_state())
+        else:
+            _save_verified(checkpointer, step, get_state(), logger)
 
     report = RunReport()
     step = resume_latest(checkpointer, set_state, logger)
@@ -678,9 +783,11 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                     pass
             step += 1
             if checkpoint_every and step % checkpoint_every == 0:
-                _save_verified(checkpointer, step, get_state(), logger)
+                save_at(step)
                 last_saved = step
         if step > last_saved:
-            _save_verified(checkpointer, step, get_state(), logger)
-    report.final_step = step
+            save_at(step)
+        if is_async:
+            checkpointer.wait()   # the final commit must land before we
+    report.final_step = step      # report the run finished
     return report

@@ -1,0 +1,45 @@
+"""Crash-consistency worker for tests/test_checkpoint.py.
+
+Commits step 10 cleanly, then arms ONE MXTPU_FAULT_INJECT crash site
+and saves step 20: the injected ``os._exit`` kills the process mid-save,
+leaving the directory exactly as a power cut would.  The parent asserts
+the process died with ``resilience.CRASH_EXIT_CODE`` and that restore
+still yields the step-10 state — the previous checkpoint, never a torn
+one.
+
+Usage: ckpt_crash_worker.py <ckpt_dir> <fault_site> <sync|async>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from mxnet_tpu import checkpoint, resilience
+
+
+def state(tag):
+    return {"w": np.full((64, 64), float(tag), np.float32),
+            "b": np.arange(16, dtype=np.float32) + tag,
+            "step": tag}
+
+
+def main():
+    ckdir, site, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    ck = checkpoint.AsyncCheckpointer(
+        ckdir, async_save=(mode == "async"), rank=0, world_size=1)
+    ck.save(10, state(10))
+    ck.wait()
+    os.environ["MXTPU_FAULT_INJECT"] = f"{site}:1"
+    resilience.reset_faults()
+    ck.save(20, state(20))
+    ck.wait()
+    # only reachable if the injection never fired — the parent asserts
+    # on CRASH_EXIT_CODE, so this is a loud failure
+    print("survived: no crash was injected", flush=True)
+
+
+if __name__ == "__main__":
+    main()

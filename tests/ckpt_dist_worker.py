@@ -1,0 +1,83 @@
+"""2-process crash-consistency worker for the AsyncCheckpointer
+(tests/test_checkpoint.py, slow tier).
+
+Each rank runs an identical deterministic decay update and saves through
+one shared :class:`checkpoint.AsyncCheckpointer` every ``SAVE_EVERY``
+steps — leaves partition round-robin across the two ranks, so both the
+shard barrier and the rank-0 manifest commit are exercised for real.
+
+``CKPT_CRASH_SITE`` + ``CKPT_CRASH_STEP`` arm an injected crash on rank
+``CKPT_CRASH_RANK`` the FIRST time that step's save runs (a marker file
+keeps the relaunched gang clean): the dying rank kills its commit
+mid-flight, the survivor's barrier wedges until the collective watchdog
+aborts it, ``launch.py --max-restarts`` relaunches the gang, and both
+ranks resume from the last COMMITTED step.  The final state must match
+an uninterrupted serial replay bit-for-bit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from mxnet_tpu import checkpoint, distributed, resilience
+
+SAVE_EVERY = 5
+
+
+def apply_step(state):
+    state["w"] = (state["w"] * 0.9).astype(np.float32)
+    state["b"] = (state["b"] + state["w"].sum()).astype(np.float32)
+
+
+def initial_state():
+    return {"w": np.full((8, 8), 10.0, np.float32),
+            "b": np.zeros(4, np.float32)}
+
+
+def main():
+    work, num_steps = sys.argv[1], int(sys.argv[2])
+    distributed.init_from_env()
+    rank = distributed.rank()
+    ck = checkpoint.AsyncCheckpointer(os.path.join(work, "ckpt"),
+                                      max_to_keep=3)
+    assert ck.world_size == 2, ck.world_size
+
+    crash_site = os.environ.get("CKPT_CRASH_SITE")
+    crash_rank = int(os.environ.get("CKPT_CRASH_RANK", "0"))
+    crash_step = int(os.environ.get("CKPT_CRASH_STEP", "10"))
+    marker = os.path.join(work, "crashed_once")
+
+    state = initial_state()
+
+    def set_state(s):
+        state["w"] = np.asarray(s["w"], np.float32).copy()
+        state["b"] = np.asarray(s["b"], np.float32).copy()
+
+    start = resilience.resume_latest(ck, set_state)
+    if start:
+        print(f"worker {rank}: resumed from step {start}", flush=True)
+    for step in range(start + 1, num_steps + 1):
+        apply_step(state)
+        if step % SAVE_EVERY == 0:
+            if (crash_site and rank == crash_rank
+                    and step == crash_step
+                    and not os.path.exists(marker)):
+                # drain the PREVIOUS async commit before arming, so the
+                # injected crash fires inside THIS step's commit (the
+                # fault plan is process-global — an in-flight writer
+                # would consume it mid-way through the prior step)
+                ck.wait()
+                open(marker, "w").close()
+                os.environ["MXTPU_FAULT_INJECT"] = f"{crash_site}:1"
+                resilience.reset_faults()
+            ck.save(step, {"w": state["w"], "b": state["b"]})
+    ck.wait()
+    print(f"worker {rank}: ckpt run done at step {num_steps} "
+          f"w00={state['w'][0, 0]:.9g}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
