@@ -408,6 +408,39 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     return outs[0] if single else outs
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def accumulate_grads(params):
+    """Accumulate gradients across several ``backward()`` calls — the
+    eager side of gradient accumulation (`Trainer.train_step`'s oracle
+    for ``grad_accum > 1``; the captured program folds the same
+    accumulation into its `lax.scan` carry).
+
+    Zeroes each trainable parameter's grad buffer, switches grad_req to
+    'add' for the scope, and restores the original req on exit WITHOUT
+    re-attaching the buffer (``Parameter.grad_req``'s setter would zero
+    it, losing the accumulated sum the optimizer step is about to
+    consume).  The first microbatch therefore computes ``0 + ct`` —
+    exactly what the captured scan's zero-initialized carry computes.
+    """
+    params = [p for p in params if p._grad_req != "null"]
+    saved = [(p, p._grad_req) for p in params]
+    for p in params:
+        p.zero_grad()
+        p._grad_req = "add"
+        if p._data is not None:
+            p._data._grad_req = "add"
+    try:
+        yield
+    finally:
+        for p, req in saved:
+            p._grad_req = req
+            if p._data is not None:
+                p._data._grad_req = req
+
+
 def get_symbol(x):
     raise NotImplementedError(
         "symbol extraction from the imperative tape is not supported; "

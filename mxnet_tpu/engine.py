@@ -33,6 +33,58 @@ def set_engine_type(name: str) -> None:
     _NAIVE = name.lower() == "naiveengine"
 
 
+_COMPILE_CACHE_DIR = None
+
+
+def ensure_compile_cache() -> str | None:
+    """Point JAX's persistent compilation cache at
+    ``MXTPU_COMPILE_CACHE_DIR`` (idempotent; returns the directory, or
+    None when the env var is unset).
+
+    The whole-step capture (`gluon.captured`) compiles ONE large XLA
+    program per training configuration; on a restart after preemption
+    the retrace is unavoidable but the XLA compile — the expensive half
+    — need not be.  With the cache dir set, a restarted worker's
+    first-step latency drops to trace + cache-deserialize (bench.py's
+    ``restart_first_step_ms`` measures exactly this).  Thresholds are
+    zeroed so even small programs (the eager oracle's per-group
+    updates) persist.
+    """
+    global _COMPILE_CACHE_DIR
+    cache_dir = os.environ.get("MXTPU_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return None
+    if _COMPILE_CACHE_DIR == cache_dir:
+        return cache_dir
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except (AttributeError, ValueError):
+        pass  # older jax: defaults still persist the big programs
+    try:
+        # enable for all backends (by default jax only persists for
+        # TPU/GPU; the CPU-fallback bench path wants it too)
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "all")
+    except (AttributeError, ValueError):
+        pass
+    try:
+        # the cache module latches its enabled/dir decision at the FIRST
+        # compile; anything already compiled (e.g. parameter init ops
+        # before the Trainer existed) froze it — reset so the next
+        # compile re-reads the config and starts persisting
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:
+        pass  # cache is best-effort; compilation still works without
+    _COMPILE_CACHE_DIR = cache_dir
+    return cache_dir
+
+
 def maybe_sync(arr):
     """Block on an array if NaiveEngine mode is on. Returns the array."""
     if _NAIVE and hasattr(arr, "block_until_ready"):

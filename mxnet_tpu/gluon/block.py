@@ -312,6 +312,8 @@ class HybridBlock(Block):
         self._flags = []
         self._jit_fns = {}
         self._param_order = None
+        if not hasattr(self, "_cache_version"):
+            self._cache_version = 0
 
     def __setattr__(self, name, value):
         super().__setattr__(name, value)
@@ -321,6 +323,12 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._jit_fns = {}
         self._param_order = None
+        # Monotonic structure-version: every event that invalidates the
+        # CachedOp (parameter set, register_child, hybridize, cast, LoRA
+        # attach/detach) lands here, so external caches keyed on this
+        # block (Trainer's captured train_step) invalidate on the same
+        # events.  getattr: __setattr__ fires before __init__ finishes.
+        self._cache_version = getattr(self, "_cache_version", 0) + 1
 
     def register_child(self, block, name=None):
         if not isinstance(block, HybridBlock):

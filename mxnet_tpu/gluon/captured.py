@@ -1,0 +1,509 @@
+"""Whole-step graph capture for the imperative Gluon Trainer.
+
+`ShardedTrainer` already compiles its entire step into one XLA program;
+the imperative path — the one the tests, examples and the trainer bench
+exercise — paid 4+ dispatches per step: the CachedOp forward, the
+tape backward, the health reduction, and one GroupedUpdater program per
+param group (plus per-microbatch grad-accumulate dispatches).  This
+module is the CachedOp idea applied to the *whole step*: given a
+hybridized block, a loss, and the Trainer's configuration, trace
+
+    forward → loss → backward → (accumulate over microbatches)
+    → health guard → global-norm clip → optimizer update
+
+into ONE donated `jax.jit` program, cached per signature with the same
+keying discipline `GroupedUpdater` established.  Per-step scalars (lr,
+wd, rescale_grad, loss scale, t-folded coefficients) enter as traced
+arrays (`optimizer.grouped.dyn_columns`), so LR schedules and
+loss-scale changes never retrace.  ``grad_accum=k`` becomes a
+`lax.scan` over microbatches inside the program, with BatchNorm-style
+aux state threaded through the carry exactly as the eager path writes
+it back between microbatches.
+
+Bitwise-parity discipline (PR 2/4): the eager multi-dispatch path stays
+as the oracle behind ``MXTPU_CAPTURED_STEP=0``.  The captured trace
+re-uses the exact same math homes — `block.param_override_scope` +
+`random.key_scope` for the forward, `numerics.health_of` for the guard,
+`optimizer.grouped.build_group_step` for the update — and reproduces
+every eager *program boundary* with `_cut` (a custom-vjp
+`lax.optimization_barrier`), because XLA's fusion/FMA-contraction
+decisions are free to differ across a program boundary but not inside
+one.  Cuts sit where the eager path materializes arrays: the CachedOp
+forward output, the backward's gradient outputs, each grad-accumulate
+sum, the loss-scale seed, and the health array.  Skip-step semantics
+ride on the same `lax.cond` branches as the eager grouped programs, and
+the host still performs EXACTLY one readback per step, after the update
+dispatch (`numerics.StepGuard`).
+
+What cannot be captured falls back to the eager oracle, silently and
+per-step: non-hybridized blocks, optimizers outside the fused-plan
+table, row-sparse/multi-precision params, remat-enabled blocks,
+kvstore-backed reduction (`kvstore.captured_step_compatible`), batch
+sizes not divisible by ``grad_accum``, and steps with a pending
+``nan_grad`` fault injection (the poison has no gradient buffer to
+land in on the captured path).
+"""
+
+from __future__ import annotations
+
+import os
+
+_SENTINEL_UNSET = object()
+
+
+def captured_step_enabled() -> bool:
+    """MXTPU_CAPTURED_STEP gate (default on); 0/false/off routes
+    `Trainer.train_step` to the eager multi-dispatch oracle."""
+    return os.environ.get("MXTPU_CAPTURED_STEP", "1").lower() \
+        not in ("0", "false", "off", "")
+
+
+# -- accounting (regression-tested) --------------------------------------------
+#
+# dispatch: exactly ONE per captured step.  trace: increments only when
+# jit actually re-traces pure_step (a python side effect in the traced
+# body) — the retrace-regression tests pin this at one per signature.
+# hits/misses: Trainer-level capture-cache stats, reported by bench.py.
+
+_DISPATCH_COUNT = 0
+_TRACE_COUNT = 0
+_CACHE_HITS = 0
+_CACHE_MISSES = 0
+
+
+def dispatch_count() -> int:
+    return _DISPATCH_COUNT
+
+
+def trace_count() -> int:
+    return _TRACE_COUNT
+
+
+def cache_stats() -> dict:
+    return {"hits": _CACHE_HITS, "misses": _CACHE_MISSES}
+
+
+def reset_counters() -> None:
+    global _DISPATCH_COUNT, _TRACE_COUNT, _CACHE_HITS, _CACHE_MISSES
+    _DISPATCH_COUNT = 0
+    _TRACE_COUNT = 0
+    _CACHE_HITS = 0
+    _CACHE_MISSES = 0
+
+
+# -- the program-boundary cut --------------------------------------------------
+
+_CUT = None
+
+
+def _cut_fn():
+    """Identity with an `optimization_barrier` on both the primal and the
+    cotangent: XLA may not fuse or FMA-contract across it, in either
+    direction.  Placed wherever the eager oracle crosses a compiled
+    program boundary (a materialized array), so the captured program's
+    arithmetic is partitioned exactly like the eager dispatch chain —
+    the PR 2 lesson ("XLA FMA contraction differs across eager
+    dispatches") applied in reverse."""
+    global _CUT
+    if _CUT is None:
+        import jax
+
+        @jax.custom_vjp
+        def cut(x):
+            return jax.lax.optimization_barrier(x)
+
+        def cut_fwd(x):
+            return jax.lax.optimization_barrier(x), None
+
+        def cut_bwd(_res, ct):
+            return (jax.lax.optimization_barrier(ct),)
+
+        cut.defvjp(cut_fwd, cut_bwd)
+        _CUT = cut
+    return _CUT
+
+
+# -- eligibility ---------------------------------------------------------------
+
+def _raw(x):
+    return getattr(x, "_data", x)
+
+
+def ineligible_reason(trainer, block, loss_fn, data, grad_accum):
+    """Why this (trainer, block, loss) combination cannot be captured,
+    or None when it can.  Cheap checks only — group planning happens in
+    `get_step` (it shares `plan_items` with the eager path)."""
+    from ..optimizer import grouped as _grouped
+    from . import block as _blockmod
+
+    if not _grouped.fused_step_enabled():
+        return "fused step disabled (MXTPU_FUSED_STEP=0)"
+    from .. import kvstore as _kvs
+
+    if not _kvs.captured_step_compatible(trainer._kvstore):
+        return "kvstore reduction outside the program"
+    if trainer._update_on_kvstore:
+        return "update_on_kvstore"
+    if type(trainer._optimizer) not in _grouped._PLANS:
+        return f"optimizer {type(trainer._optimizer).__name__} has no " \
+               "fused plan"
+    if not isinstance(block, _blockmod.HybridBlock):
+        return "block is not a HybridBlock"
+    if not block._active:
+        return "block is not hybridized"
+    if dict(block._flags).get("remat"):
+        return "remat-enabled block"
+    if not callable(loss_fn):
+        return "loss is not callable"
+    if isinstance(loss_fn, _blockmod.Block) \
+            and not isinstance(loss_fn, _blockmod.HybridBlock):
+        return "loss block is not a HybridBlock"
+    k = int(grad_accum)
+    if k < 1:
+        return "grad_accum < 1"
+    if data.shape[0] % k != 0:
+        return f"batch {data.shape[0]} not divisible by grad_accum {k}"
+    for p in trainer._params:
+        if p._grad_req != "null" and \
+                getattr(p, "_grad_stype", None) == "row_sparse":
+            return "row-sparse gradients"
+    return None
+
+
+def _tree_version(block):
+    """DFS tuple of ``_cache_version`` over a block tree: any
+    `_clear_cached_op` anywhere in the tree (parameter set, child
+    registration, hybridize, cast, LoRA attach/detach/merge) changes
+    this tuple and therefore misses the capture cache — even when the
+    mutating code only cleared the leaf it touched."""
+    versions = [getattr(block, "_cache_version", 0)]
+    for child in getattr(block, "_children", {}).values():
+        versions.extend(_tree_version(child))
+    return tuple(versions)
+
+
+def _collect_blocks_params(block, loss_fn):
+    """Ordered (name, param) pairs over block + loss params, deduped by
+    identity — the forward override map must cover every parameter the
+    trace can read."""
+    from . import block as _blockmod
+
+    pairs, seen = [], set()
+    sources = [block.collect_params()]
+    if isinstance(loss_fn, _blockmod.Block):
+        sources.append(loss_fn.collect_params())
+    for params in sources:
+        for name, p in params.items():
+            if id(p) not in seen:
+                seen.add(id(p))
+                pairs.append((name, p))
+    return pairs
+
+
+# -- capture cache -------------------------------------------------------------
+
+_MAX_CACHE = 8
+
+
+def get_step(trainer, block, loss_fn, data, label, grad_accum):
+    """Return the (possibly cached) `CapturedStep` for this call
+    signature, or None when the step must run on the eager oracle.
+
+    The cache key is GroupedUpdater's keying discipline extended to the
+    whole step: (block cache-version, loss cache-version, grad_req
+    layout, optimizer group plans [kernel + static hyper-params +
+    dtype], guard/clip/amp flags, batch shapes, grad_accum, device
+    fingerprint).  Anything that invalidates the block's CachedOp —
+    parameter set, child registration, hybridize, cast, LoRA
+    attach/detach — bumps ``_cache_version`` and therefore misses here
+    too.  Per-step scalars (lr, t, wd, rescale, loss scale) are NOT in
+    the key: they enter the program as traced arrays.
+    """
+    global _CACHE_HITS, _CACHE_MISSES
+    from .. import kvstore as _kvs
+    from .. import numerics
+    from ..optimizer import grouped as _grouped
+
+    reason = ineligible_reason(trainer, block, loss_fn, data, grad_accum)
+    if reason is not None:
+        return None
+    block._ensure_initialized(data)
+
+    upd = trainer._updaters[0]
+    trained = [(i, p) for i, p in enumerate(trainer._params)
+               if p._grad_req != "null"]
+    if not trained:
+        return None
+    block_param_ids = {id(p) for _n, p
+                       in _collect_blocks_params(block, loss_fn)}
+    if any(id(p) not in block_param_ids for _i, p in trained):
+        return None  # trainer optimizes params the forward never sees
+    indices = [i for i, _p in trained]
+    weights = [p.data() for _i, p in trained]
+    # weights stand in for the grads: the captured cotangents are cast
+    # to the parameter dtype, so groupability is decided by the weight
+    groups, fallback = _grouped.plan_items(upd, indices, weights, weights)
+    if fallback:
+        return None
+
+    guard_on = numerics.grad_guard_enabled()
+    clip = trainer._clip_norm()
+    has_scaler = getattr(trainer, "_amp_loss_scaler", None) is not None
+    k = int(grad_accum)
+    plan_sig = tuple(
+        (kernel, static_items, dt, tuple(i for i, *_r in items))
+        for (kernel, static_items, dt), items in groups.items())
+    key = (
+        id(block), _tree_version(block),
+        id(loss_fn), _tree_version(loss_fn),
+        bool(getattr(loss_fn, "_active", False)),
+        tuple((i, p._grad_req) for i, p in enumerate(trainer._params)),
+        plan_sig, guard_on, clip, has_scaler, k,
+        tuple(data.shape), str(_raw(data).dtype),
+        None if label is None else (tuple(label.shape),
+                                    str(_raw(label).dtype)),
+        _kvs.device_fingerprint(),
+    )
+    cache = getattr(trainer, "_captured_cache", None)
+    if cache is None:
+        cache = trainer._captured_cache = {}
+    step = cache.get(key)
+    if step is not None:
+        _CACHE_HITS += 1
+        step._groups = groups  # fresh state/param references, same plan
+        return step
+    _CACHE_MISSES += 1
+    step = CapturedStep(trainer, block, loss_fn, trained, groups,
+                        guard_on=guard_on, clip=clip,
+                        has_scaler=has_scaler, grad_accum=k,
+                        has_label=label is not None)
+    while len(cache) >= _MAX_CACHE:
+        cache.pop(next(iter(cache)))
+    cache[key] = step
+    return step
+
+
+class CapturedStep:
+    """One compiled train-step program + the host bookkeeping around it.
+
+    The donated jit consumes (trained params, other/aux params,
+    optimizer states, per-step dyn scalars, batch, keys, loss scale)
+    and returns (new params, new others, new states, per-microbatch
+    losses, health).  Host side per step: update-count bump + dyn
+    column build (shared with GroupedUpdater), ONE dispatch, write-back
+    of the donated outputs, then the guarded finalize with its single
+    readback (`Trainer._finalize_guarded_step`).
+    """
+
+    def __init__(self, trainer, block, loss_fn, trained, groups,
+                 guard_on, clip, has_scaler, grad_accum, has_label):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._trained = trained          # [(trainer_index, Parameter)]
+        self._groups = groups            # plan_items layout
+        self._guard_on = bool(guard_on)
+        self._clip = clip
+        self._want_guard = bool(guard_on) or clip is not None
+        self._has_scaler = bool(has_scaler)
+        self._grad_accum = int(grad_accum)
+        self._has_label = bool(has_label)
+        from . import block as _blockmod
+
+        self._loss_keyed = isinstance(loss_fn, _blockmod.HybridBlock) \
+            and bool(loss_fn._active)
+        pairs = _collect_blocks_params(block, loss_fn)
+        trained_ids = {id(p) for _i, p in trained}
+        self._others = [(name, p) for name, p in pairs
+                        if id(p) not in trained_ids]
+        self._pos = {i: j for j, (i, _p) in enumerate(trained)}
+        self._fn = self._build()
+
+    # -- trace ------------------------------------------------------------------
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import autograd as _ag
+        from .. import numerics
+        from .. import random as _random
+        from ..optimizer import grouped as _grouped
+        from . import block as _blockmod
+
+        cut = _cut_fn()
+        blk, loss_fn = self._block, self._loss_fn
+        k = self._grad_accum
+        want_guard, guard_on, clip = \
+            self._want_guard, self._guard_on, self._clip
+        has_scaler, has_label = self._has_scaler, self._has_label
+        loss_keyed = self._loss_keyed
+        train_ids = [id(p) for _i, p in self._trained]
+        train_dtypes = [p.data()._data.dtype for _i, p in self._trained]
+        other_ids = [id(p) for _n, p in self._others]
+        other_names = [n for n, _p in self._others]
+        group_meta = []                 # (pure group fn, grad positions)
+        for (kernel, static_items, _dt), items in self._groups.items():
+            if want_guard:
+                gfn = _grouped.build_group_step(
+                    kernel, static_items, guarded=guard_on, clip=clip)
+            else:
+                gfn = _grouped.build_group_step(kernel, static_items)
+            group_meta.append((gfn, [self._pos[i] for i, *_r in items]))
+
+        def micro(train_vals, others, x_mb, y_mb, kb, kl, scale):
+            base_pm = dict(zip(other_ids, others))
+
+            def fwd(tv):
+                pm = dict(base_pm)
+                pm.update(zip(train_ids, tv))
+                aux = {}
+                with _blockmod.param_override_scope(pm, aux), \
+                        _ag.train_mode():
+                    with _random.key_scope(kb):
+                        out = blk.forward(x_mb)
+                    # the eager CachedOp materializes `out` between the
+                    # forward and loss programs (and the loss→block
+                    # cotangent on the way back)
+                    out = cut(out)
+                    if loss_keyed:
+                        with _random.key_scope(kl):
+                            loss = loss_fn(out, y_mb) \
+                                if y_mb is not None else loss_fn(out)
+                    else:
+                        loss = loss_fn(out, y_mb) \
+                            if y_mb is not None else loss_fn(out)
+                return loss, aux
+
+            (loss, aux), vjp_fn = jax.vjp(fwd, list(train_vals))
+            if has_scaler:
+                # eager: `loss * loss_scale` is its own program, and
+                # backward seeds ones over THAT — i.e. a full(scale)
+                seed = cut(jnp.ones_like(loss)
+                           * scale.astype(loss.dtype))
+            else:
+                seed = jnp.ones_like(loss)
+            aux_zero = jax.tree_util.tree_map(jnp.zeros_like, aux)
+            (tv_ct,) = vjp_fn((seed, aux_zero))
+            gs = [cut(g if g.dtype == dt else g.astype(dt))
+                  for g, dt in zip(tv_ct, train_dtypes)]
+            new_others = [aux.get(n, ov)
+                          for n, ov in zip(other_names, others)]
+            return loss, gs, new_others
+
+        def pure_step(train_vals, other_vals, state_vals, dyn_list,
+                      xs, ys, keys_b, keys_l, scale):
+            global _TRACE_COUNT
+            _TRACE_COUNT += 1  # python side effect: fires at trace only
+            if k == 1:
+                losses, grads, new_others = micro(
+                    train_vals, other_vals, xs, ys, keys_b, keys_l,
+                    scale)
+            else:
+                def body(carry, sl):
+                    acc, others = carry
+                    loss, gs, others = micro(
+                        train_vals, others, sl["x"], sl.get("y"),
+                        sl["kb"], sl.get("kl"), scale)
+                    # one eager `grad += ct` dispatch per microbatch
+                    acc = [cut(a + g) for a, g in zip(acc, gs)]
+                    return (acc, others), loss
+
+                sl = {"x": xs, "kb": keys_b}
+                if has_label:
+                    sl["y"] = ys
+                if loss_keyed:
+                    sl["kl"] = keys_l
+                acc0 = [jnp.zeros_like(v) for v in train_vals]
+                (grads, new_others), losses = jax.lax.scan(
+                    body, (acc0, list(other_vals)), sl)
+            health = cut(numerics.health_of(grads)) if want_guard \
+                else None
+            new_train = list(train_vals)
+            new_states = []
+            for (gfn, pos), states, dyn in zip(group_meta, state_vals,
+                                               dyn_list):
+                ws = [train_vals[p] for p in pos]
+                gsl = [grads[p] for p in pos]
+                if want_guard:
+                    nw, ns = gfn(ws, gsl, states, dyn, health)
+                else:
+                    nw, ns = gfn(ws, gsl, states, dyn)
+                for p, w in zip(pos, nw):
+                    new_train[p] = w
+                new_states.append(ns)
+            return new_train, new_others, new_states, losses, health
+
+        return jax.jit(pure_step, donate_argnums=(0, 1, 2))
+
+    # -- per-step host driver ---------------------------------------------------
+
+    def __call__(self, trainer, data, label, batch_size):
+        global _DISPATCH_COUNT
+        import numpy as _np
+
+        import jax.numpy as jnp
+
+        from .. import numerics, profiler
+        from .. import random as _random
+        from ..ndarray import _from_jax
+        from ..optimizer import grouped as _grouped
+
+        o = trainer._optimizer
+        with profiler.annotate("captured_host_prep"):
+            trainer._set_rescale(batch_size)
+            indices = [i for i, _p in self._trained]
+            snapshot = trainer._snapshot_update_counts(indices) \
+                if self._guard_on else None
+            for i in indices:
+                o._update_count(i)
+            state_vals, dyn_list = [], []
+            for (_kern, _st, dt), items in self._groups.items():
+                state_vals.append([[s._data for s in st]
+                                   for _i, _w, _g, st, _d in items])
+                dyn_list.append(_grouped.dyn_columns(
+                    o, items, _np.dtype(dt)))
+            k = self._grad_accum
+            kbs, kls = [], []
+            for _ in range(k):
+                kbs.append(_random.next_key())
+                if self._loss_keyed:
+                    kls.append(_random.next_key())
+        with profiler.annotate("captured_data"):
+            if k == 1:
+                keys_b = kbs[0]
+                keys_l = kls[0] if kls else kbs[0]
+                xs = _raw(data)
+                ys = None if label is None else _raw(label)
+            else:
+                keys_b = jnp.stack(kbs)
+                keys_l = jnp.stack(kls) if kls else keys_b
+                xr = _raw(data)
+                xs = xr.reshape((k, xr.shape[0] // k) + xr.shape[1:])
+                ys = None
+                if label is not None:
+                    yr = _raw(label)
+                    ys = yr.reshape((k, yr.shape[0] // k) + yr.shape[1:])
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        scale = _np.float32(scaler.loss_scale if scaler else 1.0)
+        train_raws = [p.data()._data for _i, p in self._trained]
+        other_raws = [p.data()._data for _n, p in self._others]
+        with profiler.annotate("captured_step"):
+            new_train, new_others, new_states, losses, health = self._fn(
+                train_raws, other_raws, state_vals, dyn_list,
+                xs, ys, keys_b, keys_l, scale)
+        _DISPATCH_COUNT += 1
+        for (_i, p), nw in zip(self._trained, new_train):
+            p.data()._set_data(nw)
+        for (_n, p), nv in zip(self._others, new_others):
+            p.data()._set_data(nv)
+        for ((_kern, _st, _dt), items), ns_group in \
+                zip(self._groups.items(), new_states):
+            for (_i, _w, _g, st, _d), ns in zip(items, ns_group):
+                for s_nd, s_new in zip(st, ns):
+                    s_nd._set_data(s_new)
+        trainer._step_count += 1
+        if self._want_guard:
+            guard = numerics.StepGuard(health, skip=self._guard_on,
+                                       clip=self._clip)
+            trainer._finalize_guarded_step(guard, snapshot)
+        return _from_jax(losses)

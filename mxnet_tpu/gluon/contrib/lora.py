@@ -94,6 +94,10 @@ class LoRADense(nn.Dense):
         self.weight.set_data(w + self._scale * delta)
         # a merged adapter contributes zero until retrained
         self.lora_b.set_data(self.lora_b.data() * 0)
+        # detach event: bump _cache_version so whole-step captures keyed
+        # on this block (Trainer.train_step) rebuild, same as attach does
+        # through register_child's clear
+        self._clear_cached_op()
         return self.weight.data()
 
 
@@ -117,6 +121,18 @@ def freeze_for_lora(net):
     if n_train == 0:
         raise ValueError("freeze_for_lora: net has no 'lora' params — "
                          "build it with lora_rank=... first")
+
+    # grad_req flips don't touch the forward program, but caches keyed
+    # on the block's structure version (the Trainer's captured
+    # train_step folds the trainable set into its program) must see the
+    # event — clear the whole tree like apply_lora does
+    def _clear(block):
+        if hasattr(block, "_clear_cached_op"):
+            block._clear_cached_op()
+        for c in block._children.values():
+            _clear(c)
+
+    _clear(net)
     return n_train, n_total
 
 

@@ -30,6 +30,8 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, clip_global_norm=None):
+        from .. import engine
+        engine.ensure_compile_cache()  # MXTPU_COMPILE_CACHE_DIR, if set
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -144,6 +146,91 @@ class Trainer:
         self._set_rescale(batch_size)
         health = self._allreduce_grads()
         self._update(ignore_stale_grad, health=health)
+
+    def train_step(self, block, loss_fn, data, label=None, batch_size=None,
+                   grad_accum=1, ignore_stale_grad=False):
+        """One full training step — forward, loss, backward, gradient
+        accumulation, health guard, clip, optimizer update — returning
+        the (per-microbatch, when ``grad_accum > 1``) loss.
+
+        When the configuration is capturable (hybridized block, fused
+        optimizer, local reduce — see `gluon.captured`), the entire
+        step runs as ONE donated jit program with a single host
+        readback after the update; otherwise (or under
+        ``MXTPU_CAPTURED_STEP=0``) it runs the eager multi-dispatch
+        path, which doubles as the captured path's bitwise oracle.
+
+        The captured path never touches the parameters' gradient
+        buffers — gradients live only inside the program — so
+        ``ignore_stale_grad`` only applies to the eager fallback, and
+        manual ``backward()`` + ``step()`` flows should not be
+        interleaved with ``train_step`` on the same trainer step.
+        """
+        from .. import resilience
+        from . import captured as _captured
+
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if batch_size is None:
+            batch_size = data.shape[0]
+        k = int(grad_accum)
+        step = None
+        # a pending nan_grad injection needs a materialized gradient
+        # buffer to land in: route that step to the eager oracle
+        if _captured.captured_step_enabled() \
+                and not resilience.fault_armed("nan_grad"):
+            step = _captured.get_step(self, block, loss_fn, data, label, k)
+        if step is not None:
+            return step(self, data, label, batch_size)
+        return self._eager_train_step(block, loss_fn, data, label,
+                                      batch_size, k, ignore_stale_grad)
+
+    def _eager_train_step(self, block, loss_fn, data, label, batch_size,
+                          grad_accum, ignore_stale_grad):
+        """The multi-dispatch step the captured program is checked
+        against: per-microbatch forward/backward with grad buffers,
+        then the regular guarded `step`."""
+        from .. import autograd as ag
+
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        k = grad_accum
+        if k == 1:
+            with ag.record():
+                out = block(data)
+                loss = loss_fn(out, label) if label is not None \
+                    else loss_fn(out)
+                scaled = loss * scaler.loss_scale \
+                    if scaler is not None else loss
+            scaled.backward()
+            result = loss
+        else:
+            if data.shape[0] % k:
+                raise ValueError(
+                    f"batch size {data.shape[0]} is not divisible by "
+                    f"grad_accum {k}")
+            m = data.shape[0] // k
+            params = [p for p in self._params if p._grad_req != "null"]
+            losses = []
+            with ag.accumulate_grads(params):
+                for j in range(k):
+                    xs = data[j * m:(j + 1) * m]
+                    ys = None if label is None \
+                        else label[j * m:(j + 1) * m]
+                    with ag.record():
+                        out = block(xs)
+                        loss = loss_fn(out, ys) if ys is not None \
+                            else loss_fn(out)
+                        scaled = loss * scaler.loss_scale \
+                            if scaler is not None else loss
+                    scaled.backward()
+                    losses.append(loss)
+            import jax.numpy as jnp
+
+            from ..ndarray import _from_jax
+
+            result = _from_jax(jnp.stack([l._data for l in losses]))
+        self.step(batch_size, ignore_stale_grad)
+        return result
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -52,6 +52,26 @@ def _device_fingerprint():
     return tuple(sorted((d.process_index, d.id) for d in jax.devices()))
 
 
+def device_fingerprint():
+    """Public alias of `_device_fingerprint` — part of every whole-step
+    capture key (`gluon.captured`): a captured train-step program bakes
+    in the device topology the same way the compiled all-reduce
+    programs here do, and must retrace when it changes."""
+    return _device_fingerprint()
+
+
+def captured_step_compatible(kv):
+    """Whether `gluon.captured` may subsume this trainer's gradient
+    reduction into the whole-step program.  Today only the local fused
+    path (no store: single worker, in-process arrays) qualifies; dist
+    stores reduce through `bucketed_pushpull`, whose collectives run in
+    their own compiled programs between backward and update, so the
+    captured path defers to the eager oracle.  When the dist reduce
+    moves in-program (a shard_map over `_per_process_mesh` around the
+    gradient stack), this predicate is where it gets unlocked."""
+    return kv is None
+
+
 def _per_process_mesh():
     """One device per process: the DCN axis both eager collectives run
     over."""

@@ -97,24 +97,31 @@ _HEALTH_FN = None
 _COMBINE_FN = None
 
 
+def health_of(arrs):
+    """Pure, traceable health reduction over raw arrays → ``(2,)`` f32
+    ``[all_finite, global_sq_norm]``.  The ONE home of the health math:
+    `grad_health` jits it for the eager path, and the whole-step capture
+    (`gluon/captured.py`) inlines it so both paths reduce in the same
+    order with the same accumulator dtype."""
+    import jax.numpy as jnp
+
+    # f32 accumulation: f16/bf16 inf/nan survive the upcast, and
+    # the squared norm of a large group would overflow in f16.
+    fin = jnp.bool_(True)
+    sq = jnp.zeros((), jnp.float32)
+    for a in arrs:
+        af = a.astype(jnp.float32)
+        fin = fin & jnp.all(jnp.isfinite(af))
+        sq = sq + jnp.sum(jnp.square(af))
+    return jnp.stack([fin.astype(jnp.float32), sq])
+
+
 def _health_fn():
     global _HEALTH_FN
     if _HEALTH_FN is None:
         import jax
-        import jax.numpy as jnp
 
-        def health(arrs):
-            # f32 accumulation: f16/bf16 inf/nan survive the upcast, and
-            # the squared norm of a large group would overflow in f16.
-            fin = jnp.bool_(True)
-            sq = jnp.zeros((), jnp.float32)
-            for a in arrs:
-                af = a.astype(jnp.float32)
-                fin = fin & jnp.all(jnp.isfinite(af))
-                sq = sq + jnp.sum(jnp.square(af))
-            return jnp.stack([fin.astype(jnp.float32), sq])
-
-        _HEALTH_FN = jax.jit(health)
+        _HEALTH_FN = jax.jit(health_of)
     return _HEALTH_FN
 
 
@@ -167,7 +174,13 @@ class StepGuard:
             _READBACK_COUNT += 1
             import numpy as _np
 
-            v = _np.asarray(self.health)
+            from . import profiler
+
+            # the step's ONE host sync: in a pipelined loop this span is
+            # where the host waits out the device (bench.py reads it for
+            # the readback share of the step-time breakdown)
+            with profiler.annotate("guard_readback"):
+                v = _np.asarray(self.health)
             self._host = (float(v[0]), float(v[1]))
         return self._host
 

@@ -270,6 +270,66 @@ def _groupable(opt, weight, grad):
 _GROUP_FN_CACHE = {}
 
 
+def build_group_step(kernel, static_items, guarded=False, clip=None):
+    """Build the PURE (unjitted) group-step function — the single home
+    of the fused update math.  `_group_fn` jits it for the eager
+    multi-dispatch path; the whole-step capture (`gluon/captured.py`)
+    inlines the SAME function into its one donated program, so the two
+    paths share every arithmetic decision (clip formula, cond
+    branching, kernel unroll order) and stay bitwise-identical.
+
+    Signatures: ``(weights, grads, states, dyn)`` when unguarded and
+    unclipped, else ``(weights, grads, states, dyn, health)``; returns
+    ``(new_weights, new_states)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    static = dict(static_items)
+
+    def run_updates(weights, grads, states, dyn, health):
+        coef = None
+        if clip is not None:
+            norm = jnp.sqrt(health[1])
+            coef = jnp.minimum(jnp.float32(1.0),
+                               jnp.float32(clip) / (norm + 1e-8))
+        new_w, new_s = [], []
+        for j in range(len(weights)):
+            kw = dict(static)
+            for name, col in dyn.items():
+                kw[name] = col[j]
+            g = grads[j]
+            if coef is not None:
+                g = g * coef.astype(g.dtype)
+            res = kernel(weights[j], g, *states[j], **kw)
+            new_w.append(res[0])
+            new_s.append(list(res[1:]))
+        return new_w, new_s
+
+    if not guarded and clip is None:
+        def group_step(weights, grads, states, dyn):
+            return run_updates(weights, grads, states, dyn, None)
+    elif not guarded:
+        def group_step(weights, grads, states, dyn, health):
+            return run_updates(weights, grads, states, dyn, health)
+    else:
+        def group_step(weights, grads, states, dyn, health):
+            ok = (health[0] > 0) & jnp.isfinite(health[1])
+
+            def do_step(ops):
+                return run_updates(*ops)
+
+            def skip_step(ops):
+                weights, _, states, _, _ = ops
+                return list(weights), [list(s) for s in states]
+
+            return jax.lax.cond(
+                ok, do_step, skip_step,
+                (weights, grads, states, dyn, health))
+
+    return group_step
+
+
 def _group_fn(kernel, static_items, guarded=False, clip=None):
     """One cached jit program per (kernel, static hyper-params, guard
     config).  Inside the trace the per-item kernels unroll into a single
@@ -292,57 +352,63 @@ def _group_fn(kernel, static_items, guarded=False, clip=None):
     fn = _GROUP_FN_CACHE.get(key)
     if fn is None:
         import jax
-        import jax.numpy as jnp
 
-        static = dict(static_items)
-
-        def run_updates(weights, grads, states, dyn, health):
-            coef = None
-            if clip is not None:
-                norm = jnp.sqrt(health[1])
-                coef = jnp.minimum(jnp.float32(1.0),
-                                   jnp.float32(clip) / (norm + 1e-8))
-            new_w, new_s = [], []
-            for j in range(len(weights)):
-                kw = dict(static)
-                for name, col in dyn.items():
-                    kw[name] = col[j]
-                g = grads[j]
-                if coef is not None:
-                    g = g * coef.astype(g.dtype)
-                res = kernel(weights[j], g, *states[j], **kw)
-                new_w.append(res[0])
-                new_s.append(list(res[1:]))
-            return new_w, new_s
-
-        if not guarded and clip is None:
-            def group_step(weights, grads, states, dyn):
-                return run_updates(weights, grads, states, dyn, None)
-        elif not guarded:
-            def group_step(weights, grads, states, dyn, health):
-                return run_updates(weights, grads, states, dyn, health)
-        else:
-            def group_step(weights, grads, states, dyn, health):
-                ok = (health[0] > 0) & jnp.isfinite(health[1])
-
-                def do_step(ops):
-                    return run_updates(*ops)
-
-                def skip_step(ops):
-                    weights, _, states, _, _ = ops
-                    return list(weights), [list(s) for s in states]
-
-                return jax.lax.cond(
-                    ok, do_step, skip_step,
-                    (weights, grads, states, dyn, health))
-
-        fn = jax.jit(group_step, donate_argnums=(0, 2))
+        fn = jax.jit(build_group_step(kernel, static_items,
+                                      guarded=guarded, clip=clip),
+                     donate_argnums=(0, 2))
         _GROUP_FN_CACHE[key] = fn
     return fn
 
 
 def _raw(x):
     return x._data if isinstance(x, NDArray) else x
+
+
+def plan_items(updater, index, grad, weight):
+    """Partition ``(index, grad, weight)`` triples into fused groups,
+    creating optimizer states on demand through the SAME
+    ``create_state_multi_precision`` call as the legacy loop.
+
+    Returns ``(groups, fallback)``: ``groups`` maps
+    ``(kernel, static_items, dtype_str)`` to item lists of
+    ``(i, w, g, state_nds, dyn_fn)``; ``fallback`` holds the triples
+    the kernels cannot express bitwise.  Shared by
+    `GroupedUpdater.__call__` and the whole-step capture
+    (`gluon/captured.py`), so both agree on what is groupable and on
+    the group keying.
+    """
+    upd = updater
+    o = upd.optimizer
+    plan = _PLANS.get(type(o))
+    groups = {}
+    fallback = []
+    for i, g, w in zip(index, grad, weight):
+        if i not in upd.states:
+            upd.states[i] = o.create_state_multi_precision(i, w)
+            upd.states_synced[i] = True
+        item = None
+        if plan is not None and _groupable(o, w, g):
+            item = plan(o, i, w, upd.states[i])
+        if item is None:
+            fallback.append((i, g, w))
+            continue
+        kernel, static, state_nds, dyn_fn = item
+        static_items = tuple(sorted(static.items()))
+        gkey = (kernel, static_items, str(_raw(w).dtype))
+        groups.setdefault(gkey, []).append((i, w, g, state_nds, dyn_fn))
+    return groups, fallback
+
+
+def dyn_columns(optimizer, items, dtype):
+    """Stack one step's per-item host scalars into one ``(n,)`` array
+    per scalar name, cast host-side to the group dtype (the rounding a
+    weakly-typed Python float would get inside the eager kernel).  Runs
+    AFTER the update-count bump; shared by the eager grouped dispatch
+    and the captured whole-step program so per-step scalars are
+    bit-identical on both paths."""
+    dyn_rows = [dyn_fn(optimizer, i) for i, _, _, _, dyn_fn in items]
+    return {name: _np.asarray([row[name] for row in dyn_rows], dtype)
+            for name in dyn_rows[0]}
 
 
 class GroupedUpdater:
@@ -374,23 +440,7 @@ class GroupedUpdater:
             guard = None  # nothing for the programs to do with it
         if not isinstance(index, (list, tuple)):
             index, grad, weight = [index], [grad], [weight]
-        plan = _PLANS.get(type(o))
-        groups = {}
-        fallback = []
-        for i, g, w in zip(index, grad, weight):
-            if i not in upd.states:
-                upd.states[i] = o.create_state_multi_precision(i, w)
-                upd.states_synced[i] = True
-            item = None
-            if plan is not None and _groupable(o, w, g):
-                item = plan(o, i, w, upd.states[i])
-            if item is None:
-                fallback.append((i, g, w))
-                continue
-            kernel, static, state_nds, dyn_fn = item
-            static_items = tuple(sorted(static.items()))
-            gkey = (kernel, static_items, str(_raw(w).dtype))
-            groups.setdefault(gkey, []).append((i, w, g, state_nds, dyn_fn))
+        groups, fallback = plan_items(upd, index, grad, weight)
         # legacy per-parameter loop for whatever the kernels can't express;
         # guarded steps skip these host-side (the guard's one readback —
         # shared with the Trainer's finalize via the StepGuard cache)
@@ -413,15 +463,11 @@ class GroupedUpdater:
             w_raws = [_raw(w) for _, w, _, _, _ in items]
             g_raws = [_raw(g) for _, _, g, _, _ in items]
             s_raws = [[_raw(s) for s in st] for _, _, _, st, _ in items]
-            dyn_rows = [dyn_fn(o, i) for i, _, _, _, dyn_fn in items]
-            # host-side cast to the group dtype = the one rounding a
-            # weakly-typed Python float would get in the eager kernel;
-            # STACKED into one (n,) array per name so the jit pytree
-            # carries 1 leaf per scalar name, not n (the per-leaf
-            # dispatch cost of n tiny args would eat the fusion win)
-            dyn = {name: _np.asarray([row[name] for row in dyn_rows],
-                                     dtype)
-                   for name in dyn_rows[0]}
+            # host-side cast + STACK into one (n,) array per name so the
+            # jit pytree carries 1 leaf per scalar name, not n (the
+            # per-leaf dispatch cost of n tiny args would eat the
+            # fusion win)
+            dyn = dyn_columns(o, items, dtype)
             if guard is None:
                 fn = _group_fn(kernel, static_items)
                 with profiler.annotate("optimizer_update"):

@@ -189,6 +189,8 @@ class ShardedTrainer:
                  batch_axis=DP, grad_accum=1, remat=None):
         import jax
 
+        from .. import engine
+        engine.ensure_compile_cache()  # MXTPU_COMPILE_CACHE_DIR, if set
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else data_parallel_mesh()

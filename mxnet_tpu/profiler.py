@@ -138,6 +138,20 @@ def get_summary(reset=False):
     return "\n".join(lines)
 
 
+def aggregates(reset=False):
+    """Structured counterpart of `get_summary`: ``{name: {count,
+    total_ms, min_ms, max_ms}}`` — bench.py derives its step-time
+    breakdown (data stall / host prep / dispatch / collective /
+    readback shares) from the named `annotate` scopes collected here."""
+    with _LOCK:
+        out = {name: {"count": count, "total_ms": total,
+                      "min_ms": mn, "max_ms": mx}
+               for name, (count, total, mn, mx) in _S.aggregate.items()}
+        if reset:
+            _S.aggregate.clear()
+    return out
+
+
 dump_profile = dump
 profiler_set_config = set_config
 profiler_set_state = set_state

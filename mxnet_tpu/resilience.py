@@ -181,6 +181,16 @@ def consume_fault(site):
     return plan is not None and plan.consume(site)
 
 
+def fault_armed(site):
+    """True while the site still has injected failures pending (does NOT
+    consume).  Lets a fast path that cannot express a site's fault —
+    e.g. the captured train step, whose gradients never materialize for
+    ``nan_grad`` poisoning — route the affected step to the path that
+    can."""
+    plan = _plan()
+    return plan is not None and plan.counts.get(site, 0) > 0
+
+
 #: exit code of an injected hard crash (``crash_during_save`` /
 #: ``crash_before_manifest``) — distinct from the watchdog's 124 so the
 #: crash-consistency tests can assert WHICH kill fired.

@@ -1,0 +1,321 @@
+"""Whole-step graph capture (gluon/captured.py + Trainer.train_step).
+
+The captured path must be a pure performance transform: ONE donated jit
+dispatch + one readback per step, bitwise-identical to the eager
+multi-dispatch oracle (forward / backward / health / per-group update
+programs) — including skipped (non-finite) steps, clipped steps, amp
+loss-scale bookkeeping, gradient accumulation, and BatchNorm aux
+threading.  Per-step scalars are traced inputs, so LR schedules and
+loss-scale changes must never retrace.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, numerics
+from mxnet_tpu.gluon import captured, nn
+from mxnet_tpu.optimizer import grouped
+
+STEPS = 10
+
+
+def _make_net(with_bn=False, seed=7):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        if with_bn:
+            net.add(nn.BatchNorm(axis=1), nn.Dropout(0.3))
+        net.add(nn.Dense(3))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def _batches(steps=STEPS, n=8, d=6, nan_at=None, seed=42):
+    rng = np.random.RandomState(seed)
+    xs = [rng.normal(size=(n, d)).astype(np.float32) for _ in range(steps)]
+    ys = [rng.randint(0, 3, size=(n,)).astype(np.float32)
+          for _ in range(steps)]
+    if nan_at is not None:
+        xs[nan_at][0, 0] = np.nan
+    return xs, ys
+
+
+def _state_leaves(state):
+    if state is None:
+        return []
+    if isinstance(state, (list, tuple)):
+        return [a for s in state for a in _state_leaves(s)]
+    return [state.asnumpy()] if hasattr(state, "asnumpy") else []
+
+
+def _run(monkeypatch, captured_on, opt="sgd", opt_params=None, k=1,
+         clip=None, nan_at=None, loss_scale=None, steps=STEPS,
+         with_bn=False):
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1" if captured_on else "0")
+    net = _make_net(with_bn=with_bn)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(
+        net.collect_params(), opt,
+        dict(opt_params or {"learning_rate": 0.1}),
+        clip_global_norm=clip)
+    if loss_scale is not None:
+        from mxnet_tpu import amp
+        trainer._amp_loss_scaler = amp.DynamicLossScaler(
+            init_scale=loss_scale)
+    xs, ys = _batches(steps=steps, nan_at=nan_at)
+    losses = []
+    for s in range(steps):
+        l = trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                               mx.nd.array(ys[s]), grad_accum=k)
+        losses.append(l.asnumpy())
+    weights = [p.data().asnumpy() for p in trainer._params]
+    states = {i: _state_leaves(st)
+              for i, st in trainer._updaters[0].states.items()}
+    return losses, weights, states, trainer
+
+
+def _assert_same(a, b):
+    le, we, se, te = a
+    lc, wc, sc, tc = b
+    for s, (x, y) in enumerate(zip(le, lc)):
+        np.testing.assert_array_equal(x, y, err_msg=f"loss step {s}")
+    for i, (x, y) in enumerate(zip(we, wc)):
+        np.testing.assert_array_equal(x, y, err_msg=f"weight {i}")
+    assert set(se) == set(sc)
+    for i in se:
+        for x, y in zip(se[i], sc[i]):
+            np.testing.assert_array_equal(x, y, err_msg=f"state {i}")
+
+
+# -- bitwise parity vs the eager oracle ----------------------------------------
+
+@pytest.mark.parametrize("opt,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("lamb", {"learning_rate": 0.01}),
+])
+@pytest.mark.parametrize("guard", ["1", "0"])
+def test_bitwise_parity(monkeypatch, opt, opt_params, guard):
+    """10 steps captured == 10 steps eager, to the last bit: losses,
+    weights, and optimizer states.  Guard-on runs include a NaN batch
+    (a skipped step on BOTH paths); guard-off runs include a tight
+    global-norm clip (every step clipped)."""
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", guard)
+    guard_on = guard == "1"
+    kw = dict(opt=opt, opt_params=opt_params,
+              nan_at=4 if guard_on else None,
+              clip=None if guard_on else 0.5)
+    eager = _run(monkeypatch, False, **kw)
+    cap = _run(monkeypatch, True, **kw)
+    _assert_same(eager, cap)
+    if guard_on:
+        assert len(eager[3].skipped_steps) == 1
+        assert len(cap[3].skipped_steps) == 1
+        assert eager[3].skipped_steps[0].step \
+            == cap[3].skipped_steps[0].step
+
+
+def test_bitwise_parity_grad_accum_bn_dropout(monkeypatch):
+    """grad_accum=2 with BatchNorm (aux threading through the scan
+    carry) and Dropout (per-microbatch PRNG keys): still bitwise."""
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    kw = dict(opt="adam", opt_params={"learning_rate": 0.01}, k=2,
+              with_bn=True, steps=6)
+    eager = _run(monkeypatch, False, **kw)
+    cap = _run(monkeypatch, True, **kw)
+    _assert_same(eager, cap)
+
+
+def test_bitwise_parity_amp_loss_scale(monkeypatch):
+    """Dynamic loss scaling: the scale is a traced input (seed =
+    full(scale)), unscaling rides rescale_grad, and the skipped NaN
+    step halves the scale identically on both paths."""
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    kw = dict(opt="sgd", opt_params={"learning_rate": 0.1},
+              nan_at=3, loss_scale=1024.0)
+    eager = _run(monkeypatch, False, **kw)
+    cap = _run(monkeypatch, True, **kw)
+    _assert_same(eager, cap)
+    assert eager[3]._amp_loss_scaler.loss_scale \
+        == cap[3]._amp_loss_scaler.loss_scale
+    assert cap[3]._amp_loss_scaler.loss_scale < 1024.0  # the halving
+
+
+# -- dispatch / readback / retrace accounting ----------------------------------
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_one_dispatch_one_readback_per_step(monkeypatch, k):
+    """The whole point: a healthy captured step is ONE compiled dispatch
+    (no separate forward/backward/health/per-group programs) and ONE
+    host readback (the guard decision, after the update)."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    net = _make_net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    xs, ys = _batches(steps=5)
+    # warm up (trace + cache miss), then measure steady state
+    trainer.train_step(net, loss_fn, mx.nd.array(xs[0]),
+                       mx.nd.array(ys[0]), grad_accum=k)
+    captured.reset_counters()
+    grouped.reset_dispatch_count()
+    numerics.reset_readback_count()
+    for s in range(1, 5):
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]), grad_accum=k)
+    assert captured.dispatch_count() == 4
+    assert grouped.dispatch_count() == 0
+    assert numerics.readback_count() == 4
+    assert captured.trace_count() == 0  # no retrace after warmup
+    stats = captured.cache_stats()
+    assert stats["hits"] == 4 and stats["misses"] == 0
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_no_retrace_on_schedule_ticks(monkeypatch, k):
+    """LR schedule ticks, loss-scale changes, and optimizer time steps
+    are traced scalars: ONE trace per configuration, ever."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    from mxnet_tpu import amp
+    net = _make_net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    trainer._amp_loss_scaler = amp.DynamicLossScaler(init_scale=256.0)
+    xs, ys = _batches(steps=8)
+    captured.reset_counters()
+    for s in range(8):
+        trainer.set_learning_rate(0.01 * (0.9 ** s))   # schedule tick
+        if s == 3:
+            trainer._amp_loss_scaler.loss_scale *= 2   # scale change
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]), grad_accum=k)
+    assert captured.trace_count() == 1
+    assert captured.cache_stats() == {"hits": 7, "misses": 1}
+    assert captured.dispatch_count() == 8
+
+
+def test_nan_grad_fault_routes_to_eager_oracle(monkeypatch, fault_inject):
+    """An armed nan_grad injection has no gradient buffer to poison
+    inside the captured program — that step must run (and skip) on the
+    eager path, then capture resumes."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    net = _make_net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    xs, ys = _batches(steps=4)
+    captured.reset_counters()
+    for s in range(4):
+        if s == 2:
+            fault_inject("nan_grad:1")
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]))
+    assert len(trainer.skipped_steps) == 1
+    assert captured.dispatch_count() == 3  # step 2 went eager
+
+
+# -- capture-cache invalidation ------------------------------------------------
+
+def test_capture_invalidates_on_lora_attach_freeze_merge(monkeypatch):
+    """apply_lora / freeze_for_lora / merge() all clear the CachedOp —
+    the captured-step cache keys on the same structure version (plus
+    the grad_req layout) and must rebuild, not replay a stale program."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    from mxnet_tpu.gluon.contrib import lora
+    net = _make_net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    xs, ys = _batches(steps=1, nan_at=None)
+    x, y = mx.nd.array(xs[0]), mx.nd.array(ys[0])
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    captured.reset_counters()
+    trainer.train_step(net, loss_fn, x, y)
+    trainer.train_step(net, loss_fn, x, y)
+    assert captured.cache_stats() == {"hits": 1, "misses": 1}
+
+    v0 = net._cache_version
+    wrapped = lora.apply_lora(net, rank=2, patterns=(".*",))
+    assert net._cache_version > v0  # attach invalidates
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+    captured.reset_counters()
+    trainer2.train_step(net, loss_fn, x, y)
+    assert captured.cache_stats()["misses"] == 1  # rebuilt, not replayed
+    before = {name: p.data().asnumpy()
+              for name, p in net.collect_params().items()
+              if "lora" not in name}
+    trainer2.train_step(net, loss_fn, x, y)
+    for name, p in net.collect_params().items():
+        if "lora" not in name:  # frozen base stayed frozen
+            np.testing.assert_array_equal(before[name], p.data().asnumpy())
+
+    v1 = net._cache_version
+    lora.freeze_for_lora(net)  # re-freeze walk bumps the version too
+    assert net._cache_version > v1
+
+    v2 = net._cache_version
+    wrapped[0].merge()  # detach event
+    assert wrapped[0]._cache_version > 0
+    assert net._cache_version == v2  # merge is local to the layer
+    captured.reset_counters()
+    trainer2.train_step(net, loss_fn, x, y)
+    assert captured.cache_stats()["misses"] == 1
+
+
+# -- fallback behavior ---------------------------------------------------------
+
+def test_eager_fallback_unhybridized_and_env_off(monkeypatch):
+    """Non-capturable configs and MXTPU_CAPTURED_STEP=0 run the eager
+    oracle — and still train."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    net = _make_net()
+    net._active = False  # un-hybridize
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    xs, ys = _batches(steps=2)
+    captured.reset_counters()
+    net(mx.nd.array(xs[0]))  # materialize deferred shapes
+    w0 = trainer._params[0].data().asnumpy().copy()
+    l = trainer.train_step(net, loss_fn, mx.nd.array(xs[0]),
+                           mx.nd.array(ys[0]))
+    assert np.isfinite(l.asnumpy()).all()
+    assert captured.dispatch_count() == 0
+    assert not (trainer._params[0].data().asnumpy() == w0).all()
+
+    net.hybridize()
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "0")
+    trainer.train_step(net, loss_fn, mx.nd.array(xs[1]),
+                       mx.nd.array(ys[1]))
+    assert captured.dispatch_count() == 0
+
+
+def test_grad_accum_batch_not_divisible_falls_back(monkeypatch):
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    net = _make_net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.RandomState(0)
+                    .normal(size=(6, 6)).astype(np.float32))
+    y = mx.nd.array(np.zeros((6,), np.float32))
+    # 6 % 4 != 0 → capture refuses; the eager path raises explicitly
+    trainer._init_kvstore()
+    assert "divisible" in captured.ineligible_reason(
+        trainer, net, loss_fn, x, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        trainer.train_step(net, loss_fn, x, y, grad_accum=4)
