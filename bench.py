@@ -365,6 +365,24 @@ def orchestrate():
         headline["guard_overhead_pct"] = \
             trainer_bench.get("guard_overhead_pct")
         headline["guard_ok"] = trainer_bench.get("guard_ok")
+        headline["trainer_mfu"] = trainer_bench.get("mfu")
+        headline["trainer_stall_share"] = trainer_bench.get("stall_share")
+        # ratio gates (ISSUE 7): pass/fail on ratios the telemetry layer
+        # computed, never on absolute CPU samples/sec
+        gates = {
+            "one_dispatch_per_step":
+                trainer_bench.get("dispatches") ==
+                trainer_bench.get("steps_timed")
+                and bool(trainer_bench.get("steps_timed")),
+            "mfu_nonnull": trainer_bench.get("mfu") is not None,
+            "stall_share_le_half":
+                trainer_bench.get("stall_share") is not None
+                and trainer_bench["stall_share"] <= 0.5,
+            "captured_le_grouped":
+                bool(trainer_bench.get("captured_le_grouped")),
+        }
+        headline["trainer_gates"] = gates
+        headline["trainer_gates_ok"] = all(gates.values())
     elif trainer_errors:
         headline["trainer_error"] = "; ".join(trainer_errors)[-300:]
     if pipe is not None:
@@ -386,8 +404,29 @@ def orchestrate():
         headline["ckpt_state_mb"] = ckpt.get("state_mb")
     elif ckpt_errors:
         headline["ckpt_error"] = "; ".join(ckpt_errors)[-300:]
+    _seal_trajectory_point(headline)
     print(json.dumps(headline))
     return 0
+
+
+def _seal_trajectory_point(headline):
+    """Refuse an untagged CPU-fallback trajectory point (ROADMAP "Perf
+    truth"): a number measured on the CPU fallback may only survive when
+    it carries the structured ``on_chip_unavailable`` record with
+    ``numbers_are_cpu: true`` and a reason — anything else is zeroed so
+    a silent CPU proxy can never be read as an on-chip result."""
+    if headline.get("backend") != "cpu":
+        return
+    tag = headline.get("on_chip_unavailable")
+    if isinstance(tag, dict) and tag.get("numbers_are_cpu") is True \
+            and tag.get("reason"):
+        return
+    headline["refused_cpu_point"] = True
+    headline["value"] = 0.0
+    prior = headline.get("error")
+    msg = ("cpu-backend measurement without a complete "
+           "on_chip_unavailable tag: trajectory point refused")
+    headline["error"] = f"{prior}; {msg}" if prior else msg
 
 
 # -- worker-side helpers -------------------------------------------------------
@@ -820,12 +859,13 @@ def bench_trainer(cfg, devices):
     this bench with the same MXTPU_COMPILE_CACHE_DIR to turn it into a
     restart-to-first-step number), captured-cache hit/miss + retrace
     counts, and a per-step breakdown (data staging / host prep /
-    dispatch / guard readback / collective) from profiler.annotate
-    scopes."""
+    dispatch / guard readback / collective / other) plus MFU and data
+    stall share, all sourced from the telemetry StepStats records the
+    timed loop emits (mxnet_tpu/telemetry.py)."""
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu import gluon, telemetry
     from mxnet_tpu.gluon import captured, nn
 
     n_params, steps = cfg["params"], cfg["steps"]
@@ -855,33 +895,40 @@ def bench_trainer(cfg, devices):
 
     _readback(step())
     captured.reset_counters()
+    telemetry.reset()
     dt, _ = _timed_loop(step, steps, per_step_readback=True)
     captured_us = dt / steps * 1e6
     stats = captured.cache_stats()
     traces = captured.trace_count()
     dispatches = captured.dispatch_count()
 
-    # per-step breakdown over a short profiled segment (the annotate
-    # scopes only record while the host profiler runs)
-    bsteps = min(10, steps)
-    profiler.aggregates(reset=True)
-    profiler.set_state("run")
-    for _ in range(bsteps):
-        _readback(step())
-    profiler.set_state("stop")
-    agg = profiler.aggregates(reset=True)
+    # breakdown / MFU / stall share from the telemetry StepStats records
+    # the timed loop just emitted — the always-on accounting IS the
+    # bench's source now, not a separately-profiled segment
+    recs = [r for r in telemetry.recent_steps()
+            if r.get("path") == "captured"][-steps:]
 
-    def _us(*names):
-        return round(sum(agg[n]["total_ms"] for n in names if n in agg)
-                     / bsteps * 1e3, 1)
+    def _mean(key, sub=None):
+        vals = [(r[key].get(sub) if sub else r.get(key)) for r in recs]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) / len(vals) if vals else None
 
-    breakdown = {
-        "data_stall_us": _us("captured_data", "h2d_prefetch"),
-        "host_prep_us": _us("captured_host_prep"),
-        "dispatch_us": _us("captured_step"),
-        "readback_us": _us("guard_readback"),
-        "collective_us": _us("allreduce", "bucket_pack"),  # 0 1-proc
-    }
+    breakdown = mfu = stall_share = None
+    skipped = 0
+    if recs:
+        breakdown = {
+            "data_stall_us": round(_mean("breakdown_us", "data"), 1),
+            "host_prep_us": round(_mean("breakdown_us", "host_prep"), 1),
+            "dispatch_us": round(_mean("breakdown_us", "dispatch"), 1),
+            "readback_us": round(_mean("breakdown_us", "readback"), 1),
+            "collective_us": round(_mean("breakdown_us", "collective"),
+                                   1),
+            "other_us": round(_mean("breakdown_us", "other"), 1),
+        }
+        m = _mean("mfu")
+        mfu = round(m, 6) if m is not None else None
+        stall_share = round(_mean("shares", "data"), 3)
+        skipped = sum(1 for r in recs if r.get("skipped"))
 
     # guard_overhead_us: health guard on (captured_us above paid for
     # it) vs MXTPU_GRAD_GUARD=0 — a different capture signature, so the
@@ -940,6 +987,10 @@ def bench_trainer(cfg, devices):
         "traces": traces,
         "dispatches": dispatches,
         "breakdown_us": breakdown,
+        "mfu": mfu,
+        "stall_share": stall_share,
+        "steps_timed": len(recs),
+        "skipped_steps": skipped,
         "guard_overhead_us": round(guard_overhead_us, 1),
         "guard_overhead_pct": round(guard_overhead_pct, 1)
         if guard_overhead_pct is not None else None,

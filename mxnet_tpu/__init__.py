@@ -53,7 +53,8 @@ for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "parallel", "test_utils", "util", "visualization", "operator",
              "symbol", "model", "module", "lr_scheduler", "distributed",
              "amp", "checkpoint", "contrib", "rtc", "image_detection",
-             "subgraph", "attribute", "monitor", "resilience", "numerics"):
+             "subgraph", "attribute", "monitor", "resilience", "numerics",
+             "telemetry"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -40,9 +40,11 @@ import signal
 import struct
 import sys
 import threading
+import time
 import zlib
 
 from . import resilience
+from . import telemetry
 from .base import MXNetError
 from .resilience import CheckpointCorrupt
 
@@ -328,19 +330,31 @@ class AsyncCheckpointer:
         ``async_save=False``).  At most ONE save is outstanding: a new
         ``save()`` first blocks on the previous commit (backpressure),
         and any error the writer hit is raised here or in ``wait()``."""
+        # everything before save() returns — backpressure join, host
+        # snapshot, sync commit — stalls the train loop; the async
+        # writer's work after that does not
+        t0 = time.perf_counter()
         self._join(raise_error=True)
         leaves, skeleton = _flatten(state)
         mine, metas = self._snapshot_local(leaves)
         if not self.async_save:
             with resilience.guard_checkpoint(f"ckpt_save:{step}"):
                 self._commit(step, mine, metas, skeleton)
+            self._count_stall(t0)
             return step
         self._pending_step = step
         self._thread = threading.Thread(
             target=self._writer, args=(step, mine, metas, skeleton),
             name=f"ckpt_writer:{step}", daemon=True)
         self._thread.start()
+        self._count_stall(t0)
         return step
+
+    @staticmethod
+    def _count_stall(t0):
+        telemetry.count("ckpt.stall_us",
+                        int((time.perf_counter() - t0) * 1e6))
+        telemetry.count("ckpt.saves")
 
     def _snapshot_local(self, leaves):
         """Host-copy THIS rank's leaves; record every leaf's meta.
@@ -416,6 +430,8 @@ class AsyncCheckpointer:
             self._prune()
         self._log(f"checkpoint step {step} committed "
                   f"(rank {self.rank}/{self.world_size})")
+        telemetry.count("ckpt.commits")
+        telemetry.event("ckpt_commit", step=int(step), rank=self.rank)
 
     def _write_manifest(self, step, sdir, skeleton):
         shards, leaf_meta = [], {}

@@ -129,6 +129,22 @@ def _raw(x):
     return getattr(x, "_data", x)
 
 
+def _arg_specs_of(args):
+    """Abstract (shape, dtype) skeleton of one dispatch's arguments —
+    enough to re-lower the program for cost analysis after the real
+    buffers were donated.  Returns None when any leaf lacks an aval."""
+    import jax
+    import numpy as _np
+
+    try:
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                _np.shape(a), getattr(a, "dtype", _np.asarray(a).dtype)),
+            args)
+    except Exception:
+        return None
+
+
 def ineligible_reason(trainer, block, loss_fn, data, grad_accum):
     """Why this (trainer, block, loss) combination cannot be captured,
     or None when it can.  Cheap checks only — group planning happens in
@@ -316,6 +332,11 @@ class CapturedStep:
         self._others = [(name, p) for name, p in pairs
                         if id(p) not in trained_ids]
         self._pos = {i: j for j, (i, _p) in enumerate(trained)}
+        # MFU accounting (mxnet_tpu/telemetry.py): arg avals captured on
+        # the first dispatch, cost analysis lowered lazily ONCE per
+        # capture signature — never on the per-step path
+        self._arg_specs = None
+        self._flops = _SENTINEL_UNSET
         self._fn = self._build()
 
     # -- trace ------------------------------------------------------------------
@@ -487,6 +508,13 @@ class CapturedStep:
         scale = _np.float32(scaler.loss_scale if scaler else 1.0)
         train_raws = [p.data()._data for _i, p in self._trained]
         other_raws = [p.data()._data for _n, p in self._others]
+        if self._arg_specs is None:
+            from .. import telemetry
+
+            if telemetry.enabled():
+                self._arg_specs = _arg_specs_of(
+                    (train_raws, other_raws, state_vals, dyn_list,
+                     xs, ys, keys_b, keys_l, scale))
         with profiler.annotate("captured_step"):
             new_train, new_others, new_states, losses, health = self._fn(
                 train_raws, other_raws, state_vals, dyn_list,
@@ -507,3 +535,28 @@ class CapturedStep:
                                        clip=self._clip)
             trainer._finalize_guarded_step(guard, snapshot)
         return _from_jax(losses)
+
+    # -- MFU accounting (mxnet_tpu/telemetry.py) --------------------------------
+
+    def cost_flops(self):
+        """Total FLOPs of the compiled step program via XLA cost
+        analysis, or None when unavailable.  Computed at most once per
+        capture signature by re-lowering against the recorded arg avals
+        (no device dispatch, no readback); the retrace this lowering
+        performs is excluded from `trace_count` — that counter pins
+        RUNTIME retraces."""
+        global _TRACE_COUNT
+        if self._flops is _SENTINEL_UNSET:
+            self._flops = None
+            if self._arg_specs is not None:
+                from .. import telemetry
+
+                saved = _TRACE_COUNT
+                try:
+                    compiled = self._fn.lower(*self._arg_specs).compile()
+                    self._flops = telemetry.flops_of_compiled(compiled)
+                except Exception:
+                    self._flops = None
+                finally:
+                    _TRACE_COUNT = saved
+        return self._flops

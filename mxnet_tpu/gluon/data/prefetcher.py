@@ -23,10 +23,12 @@ from __future__ import annotations
 import os
 import queue as _queue
 import threading
+import time as _time
 
 import numpy as _np
 
 from ... import profiler
+from ... import telemetry
 from ...ndarray.ndarray import NDArray, _from_jax
 
 
@@ -171,8 +173,21 @@ class DevicePrefetcher:
         return self._async_iter()
 
     def _sync_iter(self):
-        for batch in self._data:
-            yield place(batch, self._mesh, self._axis)
+        it = iter(self._data)
+        while True:
+            # consumer-thread stall: fetching + placing the batch happens
+            # inline, so the whole span is time the step loop waited
+            t0 = _time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            placed = place(batch, self._mesh, self._axis)
+            telemetry.count(
+                "input.wait_us",
+                int((_time.perf_counter() - t0) * 1e6))
+            telemetry.count("input.batches")
+            yield placed
 
     def _async_iter(self):
         q = _queue.Queue(maxsize=self._depth)
@@ -194,16 +209,27 @@ class DevicePrefetcher:
         t.start()
         try:
             while True:
-                try:
-                    item = q.get(timeout=0.2)
-                except _queue.Empty:
-                    if not t.is_alive() and q.empty():
-                        return  # producer died without posting (rare)
-                    continue
+                # consumer-thread stall: only the q.get wait counts — the
+                # producer's place() overlaps compute and must not be
+                # attributed to the step (it has its own h2d span)
+                t0 = _time.perf_counter()
+                while True:
+                    try:
+                        item = q.get(timeout=0.2)
+                        break
+                    except _queue.Empty:
+                        if not t.is_alive() and q.empty():
+                            return  # producer died without posting (rare)
+                        continue
+                telemetry.count(
+                    "input.wait_us",
+                    int((_time.perf_counter() - t0) * 1e6))
                 if item is _END:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                telemetry.count("input.batches")
+                telemetry.gauge_set("input.queue_depth", q.qsize())
                 yield item
         finally:
             stop.set()

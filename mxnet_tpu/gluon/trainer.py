@@ -141,11 +141,22 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce_grads + update (reference: Trainer.step)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._set_rescale(batch_size)
-        health = self._allreduce_grads()
-        self._update(ignore_stale_grad, health=health)
+        from .. import telemetry
+
+        # no-op (returns None) when train_step already opened the record
+        acc = telemetry.step_begin(path="manual")
+        n_skipped = len(self.skipped_steps)
+        try:
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._set_rescale(batch_size)
+            health = self._allreduce_grads()
+            self._update(ignore_stale_grad, health=health)
+        except BaseException:
+            telemetry.step_abort(acc)
+            raise
+        telemetry.step_end(acc, step=self._step_count,
+                           skipped=len(self.skipped_steps) > n_skipped)
 
     def train_step(self, block, loss_fn, data, label=None, batch_size=None,
                    grad_accum=1, ignore_stale_grad=False):
@@ -167,6 +178,7 @@ class Trainer:
         interleaved with ``train_step`` on the same trainer step.
         """
         from .. import resilience
+        from .. import telemetry
         from . import captured as _captured
 
         if not self._kv_initialized:
@@ -174,16 +186,35 @@ class Trainer:
         if batch_size is None:
             batch_size = data.shape[0]
         k = int(grad_accum)
+        acc = telemetry.step_begin()
+        n_skipped = len(self.skipped_steps)
         step = None
-        # a pending nan_grad injection needs a materialized gradient
-        # buffer to land in: route that step to the eager oracle
-        if _captured.captured_step_enabled() \
-                and not resilience.fault_armed("nan_grad"):
-            step = _captured.get_step(self, block, loss_fn, data, label, k)
-        if step is not None:
-            return step(self, data, label, batch_size)
-        return self._eager_train_step(block, loss_fn, data, label,
-                                      batch_size, k, ignore_stale_grad)
+        try:
+            # a pending nan_grad injection needs a materialized gradient
+            # buffer to land in: route that step to the eager oracle
+            if _captured.captured_step_enabled() \
+                    and not resilience.fault_armed("nan_grad"):
+                hits0 = _captured.cache_stats()["hits"] if acc else 0
+                step = _captured.get_step(self, block, loss_fn, data,
+                                          label, k)
+                if step is not None and acc is not None:
+                    telemetry.note_path("captured")
+                    telemetry.note(
+                        cache_hit=_captured.cache_stats()["hits"] > hits0)
+            if step is not None:
+                result = step(self, data, label, batch_size)
+                if acc is not None:
+                    telemetry.note(flops=step.cost_flops())
+            else:
+                result = self._eager_train_step(
+                    block, loss_fn, data, label, batch_size, k,
+                    ignore_stale_grad)
+        except BaseException:
+            telemetry.step_abort(acc)
+            raise
+        telemetry.step_end(acc, step=self._step_count,
+                           skipped=len(self.skipped_steps) > n_skipped)
+        return result
 
     def _eager_train_step(self, block, loss_fn, data, label, batch_size,
                           grad_accum, ignore_stale_grad):
@@ -354,6 +385,8 @@ class Trainer:
         unhealthy step the fused programs already returned the donated
         weights/states unchanged; this rolls back the host-side step
         counters, halves the amp loss scale and emits a StepSkipped."""
+        from .. import telemetry
+
         scaler = getattr(self, "_amp_loss_scaler", None)
         monitor = self.divergence_monitor
         if not guard.skip:
@@ -362,6 +395,7 @@ class Trainer:
             if monitor is not None:
                 monitor.observe(step=self._step_count,
                                 grad_norm=guard.grad_norm, healthy=True)
+            self._note_guard_scalars(guard, scaler)
             return
         healthy = guard.healthy
         if not healthy:
@@ -373,12 +407,32 @@ class Trainer:
             self.skipped_steps.append(rec)
             del self.skipped_steps[:-_MAX_SKIP_RECORDS]
             _LOG.warning("skipped optimizer step: %r", rec)
+            telemetry.count("step.skipped")
+            telemetry.event("step_skipped", step=rec.step,
+                            reason=rec.reason, grad_norm=rec.grad_norm,
+                            loss_scale=rec.loss_scale)
         if scaler is not None:
             scaler.update_scale(not healthy)
             self._scale = 1.0 / scaler.loss_scale
         if monitor is not None:
             monitor.observe(step=self._step_count,
                             grad_norm=guard.grad_norm, healthy=healthy)
+        self._note_guard_scalars(guard, scaler)
+
+    def _note_guard_scalars(self, guard, scaler):
+        """Attach guard scalars to the open StepStats record — only via
+        `StepGuard.peek()`, so telemetry never adds a host readback the
+        step didn't already pay for."""
+        from .. import telemetry
+
+        host = guard.peek()
+        if host is not None:
+            import math as _math
+            _, sq = host
+            telemetry.note(grad_norm=_math.sqrt(sq) if sq >= 0.0
+                           else float("nan"))
+        if scaler is not None:
+            telemetry.note(loss_scale=scaler.loss_scale)
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference: Trainer.save_states)."""

@@ -24,6 +24,7 @@ from .ndarray.ndarray import NDArray, _from_jax
 from . import optimizer as opt
 from . import profiler
 from . import resilience
+from . import telemetry
 
 
 def _as_list(x):
@@ -396,6 +397,8 @@ class KVStore:
         multi = self._is_dist and self.num_workers > 1
         reduced_flats = []
         for dt, (items, nbytes) in buckets:
+            telemetry.count("collective.bytes", nbytes)
+            telemetry.count("collective.buckets")
             with profiler.annotate("bucket_pack"):
                 flat = jnp.concatenate(
                     [raw.reshape(-1) for _, raw, _ in items]) \

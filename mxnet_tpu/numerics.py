@@ -184,6 +184,13 @@ class StepGuard:
             self._host = (float(v[0]), float(v[1]))
         return self._host
 
+    def peek(self):
+        """``(all_finite, global_sq_norm)`` if the host readback already
+        happened, else None.  Telemetry reads the guard through this so
+        attaching grad-norm to a StepStats record never forces a sync
+        the step would not have done anyway."""
+        return self._host
+
     @property
     def healthy(self) -> bool:
         """True iff every gradient is finite AND the global squared norm
@@ -386,4 +393,8 @@ class DivergenceMonitor:
             "divergence auto-recovery #%d: rolled back to checkpoint step "
             "%d after %d bad steps; quarantined batches: %s",
             self.recoveries, restored, bad, batches or "none supplied")
+        from . import telemetry
+        telemetry.event("divergence_rollback", step=restored,
+                        bad_steps=bad, last_step=step,
+                        quarantined=len(batches))
         return True

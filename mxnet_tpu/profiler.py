@@ -22,6 +22,8 @@ import threading
 import time
 from collections import defaultdict
 
+from . import telemetry as _telemetry
+
 _LOCK = threading.Lock()
 
 
@@ -172,7 +174,8 @@ def stop_xla_trace():
     import jax
 
     jax.profiler.stop_trace()
-    return _S.xla_dir
+    out, _S.xla_dir = _S.xla_dir, None
+    return out
 
 
 def annotate(name):
@@ -185,23 +188,40 @@ def annotate(name):
 
 class scope:
     """Annotation scope appearing in both host + XLA traces (reference:
-    profiler scopes / NVTX ranges)."""
+    profiler scopes / NVTX ranges).
+
+    The `jax.profiler.TraceAnnotation` is constructed ONLY while a trace
+    can actually see it — the host profiler running, or an XLA trace
+    opened via `start_xla_trace` — so hot-path `annotate` calls with
+    profiling off pay two `perf_counter` reads, not a context-manager
+    round-trip into jax.  The host duration is always measured and
+    forwarded to the telemetry step assembler (mxnet_tpu/telemetry.py),
+    which is how StepStats gets its breakdown without the profiler on.
+    """
+
+    __slots__ = ("name", "_jax", "_t0")
 
     def __init__(self, name):
         self.name = name
 
     def __enter__(self):
-        import jax
+        if _S.running or _S.xla_dir is not None:
+            import jax
 
-        self._jax = jax.profiler.TraceAnnotation(self.name)
-        self._jax.__enter__()
+            self._jax = jax.profiler.TraceAnnotation(self.name)
+            self._jax.__enter__()
+        else:
+            self._jax = None
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._jax.__exit__(*exc)
+        t1 = time.perf_counter()
+        if self._jax is not None:
+            self._jax.__exit__(*exc)
         if _S.running:
-            record_span(self.name, "scope", self._t0, time.perf_counter())
+            record_span(self.name, "scope", self._t0, t1)
+        _telemetry.on_scope(self.name, t1 - self._t0)
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":

@@ -91,6 +91,17 @@ class CheckpointCorrupt(MXNetError):
     """A checkpoint failed validation (bad magic/length/checksum)."""
 
 
+def _tel_event(kind, **fields):
+    """Structured telemetry event, guarded: this module also loads
+    standalone (bench.py orchestrator keeps its driver jax-free), where
+    the relative import has no package to resolve against."""
+    try:
+        from . import telemetry
+    except ImportError:
+        return
+    telemetry.event(kind, **fields)
+
+
 # -- fault injection -----------------------------------------------------------
 
 class _FaultPlan:
@@ -106,11 +117,14 @@ class _FaultPlan:
                 continue
             site, _, arg = item.partition(":")
             if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
-                        "crash_during_save", "crash_before_manifest"):
+                        "crash_during_save", "crash_before_manifest",
+                        "telemetry_crash"):
                 # nan_grad: poison one gradient with NaN before health
                 # assessment (consumed by the Trainer's numerics guard);
                 # inf_loss: corrupt the loss seen by
-                # numerics.DivergenceMonitor.observe
+                # numerics.DivergenceMonitor.observe;
+                # telemetry_crash: kill the process mid-JSONL-append
+                # (telemetry._emit) to prove the log stays parseable
                 self.counts[site] = int(arg) if arg else 1
             elif site in ("corrupt_record", "sigterm_at_step",
                           "corrupt_shard"):
@@ -406,6 +420,11 @@ class Watchdog:
                     f"[resilience] watchdog '{self.name}' expired after "
                     f"{self.timeout:.1f}s (action={self.action})\n")
                 stream.flush()
+            except Exception:
+                pass
+            try:
+                _tel_event("watchdog_expired", name=self.name,
+                           timeout_s=self.timeout, action=self.action)
             except Exception:
                 pass
             if self.dump_stacks:
@@ -742,6 +761,7 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
     report = RunReport()
     step = resume_latest(checkpointer, set_state, logger)
     report.resumed_from.append(step)
+    _tel_event("resume", step=step)
     last_saved = step
     step_box = [step]
     with PreemptionHandler(checkpointer, get_state,
@@ -768,6 +788,7 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                 handler.preempted.clear()
                 step = resume_latest(checkpointer, set_state, logger)
                 report.resumed_from.append(step)
+                _tel_event("restart", step=step, reason="preempted")
                 continue
             try:
                 if watchdog_timeout:
@@ -783,8 +804,10 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                 _log(logger, f"step {step} failed ({type(e).__name__}: "
                              f"{e}); restart "
                              f"{report.restarts}/{max_restarts}")
+                reason = type(e).__name__
                 step = resume_latest(checkpointer, set_state, logger)
                 report.resumed_from.append(step)
+                _tel_event("restart", step=step, reason=reason)
                 continue
             if loss is not None:
                 try:

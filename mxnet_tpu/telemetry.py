@@ -1,0 +1,581 @@
+"""Always-on training telemetry: metrics registry, per-step StepStats,
+MFU accounting, and a crash-safe JSONL event log.
+
+NEW, TPU-first (no reference analog — the reference's profiler is
+opt-in and throws its data away between runs).  Once the whole step
+collapses into one compiled program (gluon/captured.py), *attribution*
+— knowing whether wall time went to data staging, host prep, dispatch,
+collectives, or the guard readback — is the only way to find the next
+bottleneck (PyGraph / XLA-fusion papers, PAPERS.md).  This module keeps
+that attribution, always, at <1% of step time:
+
+- `MetricsRegistry` — process-wide counters / gauges / time-and-byte
+  histograms.  Components increment (`count`, `gauge_set`, `observe`);
+  the per-step assembler reads deltas.  No device work, ever.
+- `StepStats` — ONE record per training step, assembled from the
+  existing single host readback plus the `profiler.annotate` scope
+  durations (forwarded here by the profiler's scope hook): step wall
+  time, data-stall share, host prep, dispatch, guard readback,
+  collective bytes/buckets, capture-cache hit, skipped-step flag, and
+  MFU.  Breakdown shares (including ``other``) sum to 1.0 over the
+  inter-step interval.
+- MFU — FLOPs come from the compiled step program's own XLA cost
+  analysis (`CapturedStep.cost_flops`, one lowering per capture
+  signature, never per step), divided by the per-device-kind peak-FLOPs
+  table below (`MXTPU_PEAK_FLOPS` overrides).
+- Event log — append-only JSONL (`MXTPU_TELEMETRY_PATH`), one
+  run-id-stamped record per step plus discrete events (skip-step,
+  divergence rollback, watchdog expiry, restart, checkpoint commit).
+  Writes are line-buffered and flushed per record; a crash mid-append
+  leaves every earlier line parseable (readers skip a truncated tail —
+  `tools/trace_report.py`).  Without a path, records land in a bounded
+  in-memory ring (`recent_steps()`), which is how bench.py reads them.
+
+Controlled by ``MXTPU_TELEMETRY`` (default on).  Zero extra device
+dispatches or host readbacks: everything here is host timers and dict
+assembly (pinned by tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+SCHEMA_VERSION = 1
+
+#: bf16 peak FLOP/s per chip by device-kind substring (public specs).
+#: The ``cpu`` entry is a NOMINAL host figure so ratio gating works on
+#: the CPU test mesh — CPU "MFU" is a relative gate, not a truth claim
+#: (docs/observability.md).  ``MXTPU_PEAK_FLOPS`` overrides everything.
+PEAK_FLOPS = [
+    ("v6e", 918e12), ("v6", 918e12),
+    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ("cpu", 2e11),
+]
+
+_BREAKDOWN_KEYS = ("data", "host_prep", "dispatch", "readback",
+                   "collective", "other")
+
+#: profiler.annotate scope name -> breakdown bucket.  ``h2d_prefetch``
+#: is deliberately absent: it runs on the prefetcher's producer thread,
+#: overlapped with compute, so adding it would double-count wall time
+#: (it is reported separately via the ``input.wait_us`` counter).
+_SCOPE_BUCKET = {
+    "captured_data": "data",
+    "captured_host_prep": "host_prep",
+    "captured_step": "dispatch",
+    "optimizer_update": "dispatch",
+    "guard_readback": "readback",
+    "allreduce": "collective",
+    "bucket_pack": "collective",
+}
+
+
+def enabled() -> bool:
+    """MXTPU_TELEMETRY gate (default on); 0/false/off makes every hook
+    in this module a no-op."""
+    return os.environ.get("MXTPU_TELEMETRY", "1").lower() \
+        not in ("0", "false", "off", "")
+
+
+def telemetry_path():
+    """MXTPU_TELEMETRY_PATH: JSONL sink for step records and events;
+    unset = in-memory ring only (`recent_steps()`)."""
+    return os.environ.get("MXTPU_TELEMETRY_PATH") or None
+
+
+# -- metrics registry ----------------------------------------------------------
+
+class Counter:
+    """Monotonic counter (steps, bytes, accumulated wait time)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        with _LOCK:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, loss scale)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+
+    def set(self, v):
+        self.value = v
+
+
+class Histogram:
+    """Time/byte distribution: count, total, min, max (the same shape
+    as the profiler's aggregate table — enough for stall attribution
+    without per-sample storage)."""
+
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def observe(self, v):
+        with _LOCK:
+            self.count += 1
+            self.total += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def summary(self):
+        return {"count": self.count, "total": self.total,
+                "min": self.min if self.count else None, "max": self.max}
+
+
+class MetricsRegistry:
+    """Process-wide named-metric store.  `counter`/`gauge`/`histogram`
+    create-or-return; `snapshot()` is the read surface the per-step
+    assembler and tests use."""
+
+    def __init__(self):
+        self._metrics = {}
+
+    def _get(self, name, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with _LOCK:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}")
+        return m
+
+    def counter(self, name) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict:
+        out = {}
+        for name, m in list(self._metrics.items()):
+            if isinstance(m, Histogram):
+                out[name] = m.summary()
+            else:
+                out[name] = m.value
+        return out
+
+    def reset(self):
+        with _LOCK:
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def count(name, n=1):
+    """Shorthand hook for hot paths: no-op when telemetry is off."""
+    if enabled():
+        REGISTRY.counter(name).inc(n)
+
+
+def gauge_set(name, v):
+    if enabled():
+        REGISTRY.gauge(name).set(v)
+
+
+def observe(name, v):
+    if enabled():
+        REGISTRY.histogram(name).observe(v)
+
+
+# -- run identity and the JSONL sink -------------------------------------------
+
+_RUN_ID = f"{os.getpid():x}-{int(time.time() * 1000) & 0xffffffff:08x}"
+_SINK = None          # (path, file object)
+_RECENT = []          # bounded ring of step records (bench.py reads it)
+_RECENT_MAX = 256
+_EVENT_COUNTS = {}    # event kind -> count (cheap test/report surface)
+
+
+def run_id() -> str:
+    return _RUN_ID
+
+
+def _sink_file():
+    """Lazily opened append-only JSONL file; reopened if the configured
+    path changes (tests point it at per-test tmp dirs)."""
+    global _SINK
+    path = telemetry_path()
+    with _LOCK:
+        if path is None:
+            if _SINK is not None:
+                try:
+                    _SINK[1].close()
+                except OSError:
+                    pass
+                _SINK = None
+            return None
+        if _SINK is None or _SINK[0] != path:
+            if _SINK is not None:
+                try:
+                    _SINK[1].close()
+                except OSError:
+                    pass
+            f = open(path, "a", encoding="utf-8")
+            _SINK = (path, f)
+        return _SINK[1]
+
+
+def _emit(record):
+    """Append one record to the ring and (when configured) the JSONL
+    log.  One line per record, flushed immediately: a crash between
+    records loses nothing, a crash mid-write truncates only the last
+    line (readers skip it)."""
+    with _LOCK:
+        _RECENT.append(record)
+        del _RECENT[:-_RECENT_MAX]
+    f = _sink_file()
+    if f is None:
+        return
+    line = json.dumps(record, separators=(",", ":")) + "\n"
+    try:
+        from . import resilience as _res
+    except ImportError:        # standalone import (tools/trace_report)
+        _res = None
+    with _LOCK:
+        if _res is not None and _res.consume_fault("telemetry_crash"):
+            # hermetic crash-mid-append: half a line, then power loss
+            f.write(line[:max(1, len(line) // 2)])
+            f.flush()
+            os._exit(_res.CRASH_EXIT_CODE)
+        try:
+            f.write(line)
+            f.flush()
+        except OSError:
+            pass               # telemetry must never kill training
+
+
+def recent_steps(path=None):
+    """The in-memory ring of step records, oldest first (optionally
+    filtered by step path: 'captured' / 'eager' / 'manual')."""
+    with _LOCK:
+        recs = [r for r in _RECENT if r.get("type") == "step"]
+    if path is not None:
+        recs = [r for r in recs if r.get("path") == path]
+    return recs
+
+
+def event_counts() -> dict:
+    with _LOCK:
+        return dict(_EVENT_COUNTS)
+
+
+def reset(close_sink=True):
+    """Drop ring, event counts, inter-step state, and (optionally) the
+    sink handle — test isolation, not a runtime API."""
+    global _SINK, _LAST_END, _LAST_COUNTS, _CURRENT, _PEAK_CACHE
+    with _LOCK:
+        _RECENT.clear()
+        _EVENT_COUNTS.clear()
+    _CURRENT = None
+    _LAST_END = None
+    _LAST_COUNTS = {}
+    _PEAK_CACHE = None
+    if close_sink and _SINK is not None:
+        try:
+            _SINK[1].close()
+        except OSError:
+            pass
+        _SINK = None
+
+
+def event(kind, **fields):
+    """Emit one discrete, run-id-stamped event record (watchdog fired,
+    step skipped, divergence rollback, restart, checkpoint commit)."""
+    if not enabled():
+        return
+    rec = {"type": "event", "v": SCHEMA_VERSION, "run": _RUN_ID,
+           "t": time.time(), "event": str(kind)}
+    for k, v in fields.items():
+        if v is not None:
+            rec[k] = v
+    with _LOCK:
+        _EVENT_COUNTS[kind] = _EVENT_COUNTS.get(kind, 0) + 1
+    _emit(rec)
+
+
+# -- per-step assembly ---------------------------------------------------------
+
+#: counters whose per-step DELTA lands in each StepStats record
+_DELTA_COUNTERS = ("collective.bytes", "collective.buckets",
+                   "input.wait_us", "ckpt.stall_us")
+
+_CURRENT = None       # open _StepAccum, at most one per process
+_LAST_END = None      # perf_counter at the previous step_end
+_LAST_COUNTS = {}     # counter snapshot at the previous step_end
+
+
+class _StepAccum:
+    """Accumulator for one in-flight step (returned by `step_begin`)."""
+
+    __slots__ = ("t0", "tid", "path", "scopes", "fields")
+
+    def __init__(self, path):
+        self.t0 = time.perf_counter()
+        self.tid = threading.get_ident()
+        self.path = path
+        self.scopes = {}
+        self.fields = {}
+
+
+def step_begin(path="eager"):
+    """Open the per-step accumulator; returns None when telemetry is off
+    or a step is already open (nested Trainer.step inside train_step)."""
+    global _CURRENT
+    if not enabled() or _CURRENT is not None:
+        return None
+    _CURRENT = _StepAccum(path)
+    return _CURRENT
+
+
+def on_scope(name, dur_s):
+    """Profiler scope hook: `profiler.scope.__exit__` forwards every
+    annotate duration here.  Only scopes on the step-owning thread count
+    toward the breakdown (producer-thread work overlaps compute)."""
+    acc = _CURRENT
+    if acc is None or threading.get_ident() != acc.tid:
+        return
+    acc.scopes[name] = acc.scopes.get(name, 0.0) + dur_s
+
+
+def step_abort(acc):
+    """Discard an open accumulator without emitting (step raised): the
+    next step_begin must not find a stale open record."""
+    global _CURRENT
+    if acc is not None and acc is _CURRENT:
+        _CURRENT = None
+
+
+def note(**fields):
+    """Attach fields (grad_norm, loss_scale, flops, cache_hit, ...) to
+    the currently open step record; no-op when none is open."""
+    acc = _CURRENT
+    if acc is None:
+        return
+    for k, v in fields.items():
+        if v is not None:
+            acc.fields[k] = v
+
+
+def note_path(path):
+    acc = _CURRENT
+    if acc is not None:
+        acc.path = path
+
+
+def step_end(acc, step=None, skipped=False):
+    """Close the accumulator into one StepStats record and emit it.
+
+    The breakdown interval is ``now - previous step_end`` (first step:
+    ``now - step_begin``) so the wait for the NEXT batch — which happens
+    between `train_step` calls — is attributed to the step it stalled.
+    Shares, including ``other``, sum to 1.0 over that interval.
+    """
+    global _CURRENT, _LAST_END, _LAST_COUNTS
+    if acc is None or acc is not _CURRENT:
+        return None
+    _CURRENT = None
+    now = time.perf_counter()
+    wall_us = (now - acc.t0) * 1e6
+    start = _LAST_END if _LAST_END is not None else acc.t0
+    interval_us = max((now - start) * 1e6, wall_us, 1e-3)
+    # lock-free metric reads (dict.get is atomic; a missing metric just
+    # means no traffic yet) — this runs once per training step
+    metrics = REGISTRY._metrics
+    counts = {}
+    deltas = {}
+    for name in _DELTA_COUNTERS:
+        m = metrics.get(name)
+        counts[name] = v = m.value if m is not None else 0
+        deltas[name] = v - _LAST_COUNTS.get(name, 0)
+    _LAST_END = now
+    _LAST_COUNTS = counts
+
+    parts = dict.fromkeys(_BREAKDOWN_KEYS[:-1], 0.0)
+    for scope_name, dur in acc.scopes.items():
+        bucket = _SCOPE_BUCKET.get(scope_name)
+        if bucket is not None:
+            parts[bucket] += dur * 1e6
+    parts["data"] += deltas["input.wait_us"]
+    known = sum(parts.values())
+    parts["other"] = max(interval_us - known, 0.0)
+    total = sum(parts.values()) or 1.0
+
+    rec = {
+        "type": "step", "v": SCHEMA_VERSION, "run": _RUN_ID,
+        "t": time.time(),
+        "step": int(step) if step is not None else None,
+        "path": acc.path,
+        "skipped": bool(skipped),
+        # deliberately un-rounded: 16 round() calls cost ~6us/step,
+        # a third of the whole mechanism's overhead budget
+        "wall_us": wall_us,
+        "interval_us": interval_us,
+        "breakdown_us": parts,
+        "shares": {k: v / total for k, v in parts.items()},
+        "collective_bytes": int(deltas["collective.bytes"]),
+        "collective_buckets": int(deltas["collective.buckets"]),
+        "ckpt_stall_us": deltas["ckpt.stall_us"],
+        "input_queue_depth": getattr(
+            metrics.get("input.queue_depth"), "value", None),
+    }
+    flops = acc.fields.pop("flops", None)
+    rec["flops"] = flops
+    mfu = None
+    if flops:
+        peak = peak_flops()
+        if peak:
+            mfu = flops / (interval_us * 1e-6) / peak
+    rec["mfu"] = round(mfu, 6) if mfu is not None else None
+    for k, v in acc.fields.items():
+        rec[k] = v
+    _emit(rec)
+    return rec
+
+
+# -- MFU accounting ------------------------------------------------------------
+
+_PEAK_CACHE = None
+
+
+def peak_flops():
+    """Peak FLOP/s of the step's device: MXTPU_PEAK_FLOPS override,
+    else the device-kind table (bf16 figures; nominal for CPU).  None
+    when the kind is unknown — MFU is then reported as null rather than
+    against a made-up denominator."""
+    global _PEAK_CACHE
+    # env override resolves into the cache too (cleared by reset()):
+    # this sits on the per-step hot path, one environ read per step is
+    # measurable against the <1% overhead budget
+    if _PEAK_CACHE is not None:
+        return _PEAK_CACHE or None
+    raw = os.environ.get("MXTPU_PEAK_FLOPS")
+    if raw:
+        try:
+            val = float(raw)
+            if val > 0:
+                _PEAK_CACHE = val
+                return val
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        kind = (getattr(d, "device_kind", "") or d.platform or "").lower()
+    except Exception:
+        return None
+    val = 0.0
+    for key, v in PEAK_FLOPS:
+        if key in kind:
+            val = v
+            break
+    _PEAK_CACHE = val
+    return val or None
+
+
+def flops_of_compiled(compiled):
+    """XLA cost analysis of a `jax.stages.Compiled` → total FLOPs, or
+    None when the backend does not report them."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        flops = ca.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+# -- schema validation (tests + tools/trace_report.py --validate) --------------
+
+def validate_record(rec):
+    """Raise ValueError unless `rec` is a well-formed telemetry record.
+    The authoritative schema spec lives in docs/observability.md."""
+
+    def fail(msg):
+        raise ValueError(f"telemetry record invalid: {msg}; record={rec!r}")
+
+    if not isinstance(rec, dict):
+        fail("not an object")
+    kind = rec.get("type")
+    if kind not in ("step", "event"):
+        fail(f"type must be 'step'|'event', got {kind!r}")
+    if not isinstance(rec.get("run"), str) or not rec["run"]:
+        fail("missing run id")
+    if not isinstance(rec.get("t"), (int, float)):
+        fail("missing timestamp t")
+    if rec.get("v") != SCHEMA_VERSION:
+        fail(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    if kind == "event":
+        if not isinstance(rec.get("event"), str) or not rec["event"]:
+            fail("event record missing event kind")
+        step = rec.get("step")
+        if step is not None and not isinstance(step, int):
+            fail("event step must be an int")
+        return rec
+    if rec.get("step") is not None and not isinstance(rec["step"], int):
+        fail("step must be an int or null")
+    if rec.get("path") not in ("captured", "eager", "manual"):
+        fail(f"unknown path {rec.get('path')!r}")
+    if not isinstance(rec.get("skipped"), bool):
+        fail("skipped must be a bool")
+    for key in ("wall_us", "interval_us"):
+        val = rec.get(key)
+        if not isinstance(val, (int, float)) or val < 0:
+            fail(f"{key} must be a non-negative number")
+    for section in ("breakdown_us", "shares"):
+        obj = rec.get(section)
+        if not isinstance(obj, dict) or \
+                set(obj) != set(_BREAKDOWN_KEYS):
+            fail(f"{section} must have keys {_BREAKDOWN_KEYS}")
+        for k, val in obj.items():
+            if not isinstance(val, (int, float)) or val < 0:
+                fail(f"{section}[{k}] must be a non-negative number")
+    total = sum(rec["shares"].values())
+    if not 0.98 <= total <= 1.02:
+        fail(f"shares sum to {total}, expected ~1.0")
+    for key in ("collective_bytes", "collective_buckets"):
+        if not isinstance(rec.get(key), int) or rec[key] < 0:
+            fail(f"{key} must be a non-negative int")
+    for key in ("flops", "mfu", "grad_norm", "loss_scale"):
+        val = rec.get(key)
+        if val is not None and not isinstance(val, (int, float)):
+            fail(f"{key} must be a number or null")
+    if rec.get("cache_hit") is not None and \
+            not isinstance(rec["cache_hit"], bool):
+        fail("cache_hit must be a bool or null")
+    return rec

@@ -1,0 +1,36 @@
+"""Crash-mid-append worker for tests/test_telemetry.py.
+
+Emits three event records cleanly into the JSONL sink, then arms the
+``telemetry_crash`` fault site and emits a fourth: the injected
+``os._exit`` fires inside ``telemetry._emit`` after HALF the line is
+written and flushed — the on-disk state a power cut mid-append leaves.
+The parent asserts the process died with ``resilience.CRASH_EXIT_CODE``,
+that the three earlier lines still parse, and that readers
+(``tools/trace_report.py``) skip the truncated tail.
+
+Usage: telemetry_crash_worker.py <jsonl_path>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ["MXTPU_TELEMETRY_PATH"] = sys.argv[1]
+
+from mxnet_tpu import resilience, telemetry
+
+
+def main():
+    for i in range(3):
+        telemetry.event("marker", step=i)
+    os.environ["MXTPU_FAULT_INJECT"] = "telemetry_crash:1"
+    resilience.reset_faults()
+    telemetry.event("marker", step=3)
+    # only reachable if the injection never fired — the parent asserts
+    # on CRASH_EXIT_CODE, so this is a loud failure
+    print("survived: no crash was injected", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Human consumer of the telemetry event log (mxnet_tpu/telemetry.py).
+
+Reads a ``train_events.jsonl`` and prints, per run id: the step-time
+breakdown table (mean microseconds + share of the step interval), MFU
+statistics, and a summary of the discrete resilience events — skipped
+steps (with step ids), restarts, divergence rollbacks, watchdog
+expiries, checkpoint commits.
+
+Stdlib-only on purpose: it must run on a machine with neither jax nor
+the package installed (pull the JSONL off a pod, read it anywhere).
+``--validate`` additionally loads ``mxnet_tpu/telemetry.py`` standalone
+(importlib, no package import) and runs every record through
+``validate_record`` — the schema's executable spec.
+
+Usage:
+    python tools/trace_report.py train_events.jsonl [--validate]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+BREAKDOWN_KEYS = ("data", "host_prep", "dispatch", "readback",
+                  "collective", "other")
+
+
+def read_records(path):
+    """Parse one JSONL file; a truncated tail (crash mid-append) is
+    skipped with a warning, never a crash."""
+    records, bad = [], 0
+    with open(path, "r") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                sys.stderr.write(
+                    f"warning: skipping unparseable line {ln} "
+                    f"(truncated append?)\n")
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records, bad
+
+
+def _mean(vals):
+    vals = [v for v in vals if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _fmt(v, nd=1):
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def report_run(run, records, out):
+    steps = [r for r in records if r.get("type") == "step"]
+    events = [r for r in records if r.get("type") == "event"]
+    out.write(f"run {run}: {len(steps)} step records, "
+              f"{len(events)} events\n")
+    if steps:
+        wall = _mean([s.get("wall_us") for s in steps])
+        interval = _mean([s.get("interval_us") for s in steps])
+        out.write(f"  steps/s {1e6 / interval:.1f}  "
+                  f"wall {_fmt(wall)} us  interval {_fmt(interval)} us\n")
+        out.write("  breakdown (mean):\n")
+        out.write(f"    {'stage':<12}{'us':>12}{'share':>9}\n")
+        for key in BREAKDOWN_KEYS:
+            us = _mean([s.get("breakdown_us", {}).get(key)
+                        for s in steps])
+            share = _mean([s.get("shares", {}).get(key) for s in steps])
+            out.write(f"    {key:<12}{_fmt(us):>12}"
+                      f"{_fmt(share, 3):>9}\n")
+        mfus = [s.get("mfu") for s in steps if s.get("mfu") is not None]
+        if mfus:
+            out.write(f"  mfu: mean {sum(mfus) / len(mfus):.5f}  "
+                      f"min {min(mfus):.5f}  max {max(mfus):.5f}\n")
+        else:
+            out.write("  mfu: unavailable (no cost analysis / unknown "
+                      "device peak)\n")
+        cbytes = sum(s.get("collective_bytes") or 0 for s in steps)
+        cbuckets = sum(s.get("collective_buckets") or 0 for s in steps)
+        if cbuckets:
+            out.write(f"  collectives: {cbytes} bytes in {cbuckets} "
+                      f"buckets\n")
+        skipped = [s for s in steps if s.get("skipped")]
+        if skipped:
+            ids = [s.get("step") for s in skipped]
+            out.write(f"  skipped steps: {len(skipped)} "
+                      f"(ids {ids})\n")
+    if events:
+        kinds = {}
+        for e in events:
+            kinds.setdefault(e.get("event", "?"), []).append(e)
+        out.write("  events:\n")
+        for kind in sorted(kinds):
+            group = kinds[kind]
+            ids = [e["step"] for e in group if "step" in e]
+            at = f" at steps {ids}" if ids else ""
+            out.write(f"    {kind}: {len(group)}{at}\n")
+
+
+def validate_all(records):
+    """Run every record through the package's validate_record without
+    importing the package (and without needing jax installed)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu", "telemetry.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_telemetry",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors = []
+    for i, rec in enumerate(records):
+        try:
+            mod.validate_record(rec)
+        except ValueError as e:
+            errors.append(f"record {i}: {e}")
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a mxnet_tpu train_events.jsonl")
+    ap.add_argument("path", help="path to the JSONL event log")
+    ap.add_argument("--validate", action="store_true",
+                    help="validate every record against the schema")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.path):
+        sys.stderr.write(f"error: no such file: {args.path}\n")
+        return 2
+    records, bad = read_records(args.path)
+    if not records:
+        sys.stderr.write("error: no parseable records\n")
+        return 2
+    if args.validate:
+        errors = validate_all(records)
+        if errors:
+            for err in errors:
+                sys.stderr.write(f"schema violation: {err}\n")
+            return 1
+        print(f"{len(records)} records validate against schema "
+              f"v{records[0].get('v', '?')}")
+    runs = {}
+    for rec in records:
+        runs.setdefault(rec.get("run", "?"), []).append(rec)
+    for run in runs:
+        report_run(run, runs[run], sys.stdout)
+    if bad:
+        print(f"({bad} unparseable line(s) skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
