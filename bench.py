@@ -212,6 +212,194 @@ def _ckpt_attempts():
     ]
 
 
+def _recovery_cfg():
+    return {
+        "world": 3,
+        # enough steps AFTER the kill that the survivors are still
+        # mid-run when the heartbeat timeout confirms the death — a gang
+        # that finishes first never needs to reshape
+        "steps": int(os.environ.get("BENCH_RECOVERY_STEPS", 60)),
+        "snap_every": 5,
+        # NOT a snapshot multiple: the victim must have shipped its
+        # shard to the buddy before dying for the peer-RAM path
+        "kill_step": 12,
+        "step_ms": 20.0,
+        "n": 1 << 15,
+        "hb_interval": "0.04",
+        "hb_timeout": "0.4",
+        "budget": int(os.environ.get("BENCH_RECOVERY_BUDGET", 120)),
+    }
+
+
+def _gang_env(extra):
+    """Worker env for the recovery gangs: hostile accelerator claims and
+    stale gang/fault knobs stripped, then the scenario's own knobs."""
+    drop = ("MXTPU_FAULT_INJECT", "MXTPU_KILL_AT_STEP", "MXTPU_GANG_DIR",
+            "MXTPU_HEARTBEAT_INTERVAL", "MXTPU_HEARTBEAT_TIMEOUT",
+            "MXTPU_PEER_SNAP_EVERY", "MXTPU_ELASTIC")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(_HOSTILE_ENV_PREFIXES) and k not in drop}
+    env.update(extra)
+    return env
+
+
+def _spawn_gang_worker(cfg, extra_env):
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--gang-worker",
+         json.dumps(cfg)],
+        env=_gang_env(extra_env), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _last_json(text):
+    for ln in reversed((text or "").strip().splitlines()):
+        try:
+            obj = json.loads(ln)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def _recovery_elastic(cfg, base, errors):
+    """3-rank elastic gang, rank 1 SIGKILLed mid-run: survivors detect,
+    reshape to 2, restore from the buddy's RAM snapshot, and finish.
+    Reports rank 0's in-process recovery latency (the span of
+    ElasticGang.recover — consensus + acks + shard assembly)."""
+    gang_dir = os.path.join(base, "gang")
+    os.makedirs(gang_dir)
+    extra = {"MXTPU_GANG_DIR": gang_dir,
+             "MXTPU_HEARTBEAT_INTERVAL": cfg["hb_interval"],
+             "MXTPU_HEARTBEAT_TIMEOUT": cfg["hb_timeout"],
+             "MXTPU_FAULT_INJECT": "kill_rank:1",
+             "MXTPU_KILL_AT_STEP": str(cfg["kill_step"])}
+    procs = [_spawn_gang_worker(
+        dict(cfg, mode="elastic", rank=r, gang_dir=gang_dir,
+             dir=os.path.join(base, "ck_elastic")), extra)
+        for r in range(cfg["world"])]
+    deadline = time.monotonic() + cfg["budget"]
+    while time.monotonic() < deadline \
+            and any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    outs = [p.communicate() for p in procs]
+    if procs[0].returncode != 0:
+        tail = (outs[0][1] or "").strip()[-200:]
+        errors.append(f"recovery/elastic rank0 "
+                      f"rc={procs[0].returncode}: {tail}")
+        return None
+    obj = _last_json(outs[0][0])
+    if not obj or obj.get("recovery_ms") is None:
+        errors.append("recovery/elastic: rank0 reported no recovery")
+        return None
+    if obj.get("final_step") != cfg["steps"]:
+        errors.append(f"recovery/elastic: rank0 stopped at "
+                      f"{obj.get('final_step')}/{cfg['steps']}")
+        return None
+    return {"elastic_recovery_ms": round(float(obj["recovery_ms"]), 1),
+            "elastic_recovery_source": obj.get("recovery_source"),
+            "elastic_disk_restores": obj.get("disk_restores")}
+
+
+def _recovery_restart(cfg, base, errors):
+    """The same failure under classic gang fate-sharing supervision
+    (tools/launch.py default mode, inlined so the measurement hooks are
+    orchestrator-local): rank 1's death tears the gang down, a FULL gang
+    is respawned, every rank resumes from its disk checkpoint.
+    full_restart_ms = death observed -> restarted rank 0 completes its
+    first post-resume step (process spawn + interpreter + restore are
+    all on the clock, exactly the cost elastic recovery avoids)."""
+    ckdir = os.path.join(base, "ck_restart")
+    marker = os.path.join(base, "resumed")
+    extra = {"MXTPU_FAULT_INJECT": "kill_rank:1",
+             "MXTPU_KILL_AT_STEP": str(cfg["kill_step"])}
+
+    def wcfg(r):
+        return dict(cfg, mode="restart", rank=r, dir=ckdir,
+                    marker=marker)
+
+    procs = [_spawn_gang_worker(wcfg(r), extra)
+             for r in range(cfg["world"])]
+    deadline = time.monotonic() + cfg["budget"]
+    t_detect = None
+    while time.monotonic() < deadline:
+        codes = [p.poll() for p in procs]
+        if any(c not in (None, 0) for c in codes):
+            t_detect = time.monotonic()
+            break
+        if all(c == 0 for c in codes):
+            break
+        time.sleep(0.05)
+    if t_detect is None:
+        errors.append("recovery/restart: no worker death observed")
+        for p in procs:
+            p.kill()
+            p.communicate()
+        return None
+    for p in procs:                       # gang fate-sharing teardown
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        p.communicate()
+    procs2 = [_spawn_gang_worker(wcfg(r), {})
+              for r in range(cfg["world"])]
+    marker0 = marker + ".rank0"
+    t_first = None
+    while time.monotonic() < deadline:
+        if os.path.exists(marker0):
+            t_first = time.monotonic()
+            break
+        if procs2[0].poll() is not None:
+            break
+        time.sleep(0.01)
+    while time.monotonic() < deadline \
+            and any(p.poll() is None for p in procs2):
+        time.sleep(0.1)
+    for p in procs2:
+        if p.poll() is None:
+            p.kill()
+        p.communicate()
+    if t_first is None:
+        errors.append("recovery/restart: restarted gang never reached "
+                      "a resumed step")
+        return None
+    return {"full_restart_ms": round((t_first - t_detect) * 1e3, 1)}
+
+
+def bench_recovery(errors):
+    """elastic_recovery_ms vs full_restart_ms for the SAME injected
+    failure (rank 1 of 3 SIGKILLed mid-run) — the headline claim of the
+    elastic gang work.  Orchestrator-side and jax-free end to end: the
+    gang workers are hermetic ``bench.py --gang-worker`` subprocesses
+    (numpy state, FileKV control plane), so this scenario never touches
+    the tunnel and runs identically on any host."""
+    import shutil
+    import tempfile
+
+    cfg = _recovery_cfg()
+    base = tempfile.mkdtemp(prefix="bench_recovery_")
+    out = {}
+    try:
+        out.update(_recovery_elastic(cfg, base, errors) or {})
+        out.update(_recovery_restart(cfg, base, errors) or {})
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    e_ms = out.get("elastic_recovery_ms")
+    f_ms = out.get("full_restart_ms")
+    if e_ms is not None and f_ms is not None:
+        out["elastic_recovery_speedup"] = round(f_ms / e_ms, 2) \
+            if e_ms else None
+        out["elastic_faster_than_restart"] = e_ms < f_ms
+    return out or None
+
+
 def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
@@ -304,6 +492,11 @@ def orchestrate():
             ckpt = _run_worker(env_over, cfg, budget, ckpt_errors)
             if ckpt is not None:
                 break
+    recovery = None
+    recovery_errors = []
+    if headline is not None \
+            and not os.environ.get("BENCH_SKIP_RECOVERY"):
+        recovery = bench_recovery(recovery_errors)
     if headline is None:
         print(json.dumps({
             "metric": "resnet50_train_samples_per_sec_per_chip",
@@ -404,6 +597,10 @@ def orchestrate():
         headline["ckpt_state_mb"] = ckpt.get("state_mb")
     elif ckpt_errors:
         headline["ckpt_error"] = "; ".join(ckpt_errors)[-300:]
+    if recovery:
+        headline.update(recovery)
+    if recovery_errors:
+        headline["recovery_error"] = "; ".join(recovery_errors)[-300:]
     _seal_trajectory_point(headline)
     print(json.dumps(headline))
     return 0
@@ -427,6 +624,100 @@ def _seal_trajectory_point(headline):
     msg = ("cpu-backend measurement without a complete "
            "on_chip_unavailable tag: trajectory point refused")
     headline["error"] = f"{prior}; {msg}" if prior else msg
+
+
+# -- recovery gang worker (jax-free) -------------------------------------------
+
+def _import_elastic():
+    """Import the elastic-recovery stack WITHOUT executing the package
+    __init__ (which pulls the jax array frontend in): install a bare
+    package shell for ``mxnet_tpu`` and load the submodules — they only
+    lazy-import jax, so a numpy-state gang stays jax-free and its
+    process spawn stays cheap."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if "mxnet_tpu" not in sys.modules:
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = pkg
+    res = importlib.import_module("mxnet_tpu.resilience")
+    dist = importlib.import_module("mxnet_tpu.distributed")
+    return res, dist
+
+
+def gang_worker(cfg):
+    """One rank of the hermetic recovery-bench gang.
+
+    State is a replicated numpy vector with a deterministic
+    rank-independent update, so any peer's shard (or any rank's disk
+    checkpoint) is a full restore — the bench measures recovery
+    latency, not resharding math (the elastic tests cover that).
+    """
+    import numpy as np
+
+    res, dist = _import_elastic()
+    rank, world = cfg["rank"], cfg["world"]
+    steps, snap_every = cfg["steps"], cfg["snap_every"]
+    step_s = cfg["step_ms"] / 1e3
+    state = {"w": np.full(cfg["n"], 1.0, np.float64), "step": 0}
+
+    def work(step):
+        state["w"] *= 0.9999
+        state["step"] = step
+        time.sleep(step_s)
+
+    recov = {"ms": None, "source": None, "disk_restores": 0}
+    if cfg["mode"] == "elastic":
+        kv = dist.FileKV(cfg["gang_dir"])
+        ck = res.LocalCheckpointer(
+            os.path.join(cfg["dir"], f"rank{rank}"))
+        gang = res.ElasticGang(rank, world, kv=kv, checkpointer=ck,
+                               peer_snap_every=snap_every)
+        gang.start()
+        step = 0
+        while step < steps:
+            try:
+                gang.step_tick(step, state=state)
+            except res.RankFailure as rf:
+                info = gang.recover(rf)
+                state = (next(iter(info.shards.values()))
+                         if info.shards else info.full_state)
+                step = info.snap_step
+                recov["ms"] = info.recovery_ms
+                recov["source"] = info.source
+                if info.source == "disk":
+                    recov["disk_restores"] += 1
+                continue
+            except res.GangEvicted:
+                sys.exit(0)
+            work(step)
+            step += 1
+        gang.stop()
+    else:                                    # full-restart mode
+        ck = res.LocalCheckpointer(
+            os.path.join(cfg["dir"], f"rank{rank}"))
+        start = res.resume_latest(ck, state.update)
+        step = start
+        resumed = start > 0
+        while step < steps:
+            res.maybe_kill_rank(rank, step)
+            work(step)
+            if resumed:
+                # restart-latency marker: first step COMPLETED after
+                # the disk resume
+                with open(f"{cfg['marker']}.rank{rank}", "w") as f:
+                    f.write(str(step))
+                resumed = False
+            step += 1
+            if step % snap_every == 0:
+                ck.save(step, state)
+    print(json.dumps({"rank": rank, "final_step": step,
+                      "loss": float(state["w"][0]),
+                      "recovery_ms": recov["ms"],
+                      "recovery_source": recov["source"],
+                      "disk_restores": recov["disk_restores"]}))
 
 
 # -- worker-side helpers -------------------------------------------------------
@@ -1089,5 +1380,7 @@ def bench_bert(cfg, devices):
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--worker":
         worker(json.loads(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--gang-worker":
+        gang_worker(json.loads(sys.argv[2]))
     else:
         sys.exit(orchestrate())

@@ -37,6 +37,7 @@ import os
 import pickle
 import shutil
 import signal
+import socket
 import struct
 import sys
 import threading
@@ -282,7 +283,8 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, directory, max_to_keep=3, async_save=None,
-                 rank=None, world_size=None, logger=None):
+                 rank=None, world_size=None, logger=None,
+                 barrier_fn=None):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self.max_to_keep = max_to_keep
@@ -303,11 +305,19 @@ class AsyncCheckpointer:
             self._use_barrier = False
         self.rank = int(rank)
         self.world_size = int(world_size)
+        # explicit rank/world gangs (no jax distributed runtime) can
+        # still sync the two-phase commit through a caller-supplied
+        # barrier — ElasticGang.barrier, which stays death-responsive
+        self._barrier_fn = barrier_fn
         self._logger = logger
         self._thread = None
         self._pending_step = None
         self._error = None
         self._lock = threading.Lock()
+        # peer RAM replication (attach_peers): ship each save's shard
+        # dict to the buddy rank every N saves
+        self._peer_store = None
+        self._peer_every = 0
 
     # -- paths -----------------------------------------------------------------
 
@@ -414,24 +424,46 @@ class AsyncCheckpointer:
             os.fsync(f.fileno())
         os.replace(epath + ".tmp", epath)
         resilience.fsync_dir(sdir)
-        if self._use_barrier:
-            from . import distributed
-
-            distributed.barrier(f"ckpt_shards_{step}")
+        if self._peer_store is not None and self._peer_every and \
+                int(step) % self._peer_every == 0:
+            # peer RAM replica rides the writer thread: the host shard
+            # copy already exists, so the extra cost is one pickle+send
+            buddy = (self.rank + 1) % self.world_size
+            self._peer_store.hold_own(step, mine)
+            if buddy != self.rank:
+                self._peer_store.send_to(buddy, step, mine)
+        self._barrier(f"ckpt_shards_{step}")
         resilience.maybe_crash("crash_before_manifest")
         if self.rank == 0:
             self._write_manifest(step, sdir, skeleton)
             self._corrupt_shard_fault(sdir)
-        if self._use_barrier:
-            from . import distributed
-
-            distributed.barrier(f"ckpt_commit_{step}")
+        self._barrier(f"ckpt_commit_{step}")
         if self.rank == 0:
             self._prune()
         self._log(f"checkpoint step {step} committed "
                   f"(rank {self.rank}/{self.world_size})")
         telemetry.count("ckpt.commits")
         telemetry.event("ckpt_commit", step=int(step), rank=self.rank)
+
+    def _barrier(self, name):
+        if self._barrier_fn is not None:
+            self._barrier_fn(name)
+        elif self._use_barrier:
+            from . import distributed
+
+            distributed.barrier(name)
+
+    def attach_peers(self, store, every=None):
+        """Enable peer RAM replication: every ``every`` saves (default
+        ``MXTPU_PEER_SNAP_EVERY``, 10) the writer ships this rank's CRC'd
+        shard dict to buddy ``(rank+1) % world`` via ``store`` (a
+        :class:`PeerSnapshotStore`) and keeps its own RAM copy — the
+        fast elastic-recovery source that spares the disk manifest."""
+        self._peer_store = store
+        self._peer_every = int(
+            os.environ.get("MXTPU_PEER_SNAP_EVERY", 10)
+            if every is None else every)
+        return self
 
     def _write_manifest(self, step, sdir, skeleton):
         shards, leaf_meta = [], {}
@@ -602,6 +634,7 @@ class AsyncCheckpointer:
             state = _unflatten(m["skeleton"], leaves)
         if template is not None:
             state = _apply_template(state, template)
+        telemetry.count("ckpt.disk_restores")
         return state
 
     def _load_leaves(self, step, m):
@@ -676,6 +709,257 @@ def _remove_quiet(path):
         os.remove(path)
     except OSError:
         pass
+
+
+# -- peer-replicated in-memory snapshots (elastic recovery, PR 8) --------------
+
+#: wire magic for the peer snapshot protocol (versioned like _SHARD_MAGIC)
+_PEER_MAGIC = b"MXTPSNP1"
+#: request header after the magic: cmd u8, from_rank u32, step u64,
+#: epoch u32, crc u32, payload_len u64
+_PEER_HDR = "<BIQIIQ"
+_PEER_PUT, _PEER_GET = 1, 2
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer snapshot connection closed "
+                                  "mid-frame")
+        buf += chunk
+    return buf
+
+
+class PeerSnapshotStore:
+    """RAM-resident snapshot replicas + a tiny TCP shard server.
+
+    Each rank runs one store: a daemon thread serves this rank's held
+    snapshots (its own, plus the buddy shards peers pushed) over a
+    length-prefixed CRC'd frame protocol; ``send_to`` pushes a snapshot
+    into a peer's RAM, ``fetch`` pulls one out during elastic recovery.
+    Addresses are advertised through the gang KV (``addr/<rank>``), so
+    any survivor can locate any holder without a rendezvous.
+
+    Frame: ``MXTPSNP1 | cmd u8 | from_rank u32 | step u64 | epoch u32 |
+    crc32 u32 | len u64 | pickle(snapshot_to_host(state))``.  The CRC is
+    validated on BOTH ends — a recovery source that silently bit-rots in
+    transit is worse than falling back to the disk manifest.
+
+    Retention is ``keep`` snapshot steps per source rank (default 2),
+    PLUS anything younger than ``retain_s`` (default 2x the heartbeat
+    timeout): between a rank's death and its CONFIRMATION the survivors
+    keep stepping and snapshotting, and if count-based pruning could
+    drop every step the dead rank's buddy still holds, no common
+    restore point would survive the detection window — the time floor
+    guarantees one does, with RAM cost bounded by the snapshot cadence
+    over that window.
+    """
+
+    def __init__(self, rank, kv=None, host=None, keep=2, retain_s=None):
+        self.rank = int(rank)
+        self.kv = kv
+        self.host = host or os.environ.get("MXTPU_PEER_HOST",
+                                           "127.0.0.1")
+        self.keep = int(keep)
+        if retain_s is None:
+            retain_s = float(os.environ.get(
+                "MXTPU_PEER_SNAP_RETAIN",
+                2.0 * float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT",
+                                           5.0))))
+        self.retain_s = float(retain_s)
+        self.port = None
+        self._held = {}        # from_rank -> {step: (epoch, blob)}
+        self._lock = threading.Lock()
+        self._sock = None
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self):
+        if self._sock is not None:
+            return self
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, 0))
+        s.listen(8)
+        s.settimeout(0.2)
+        self._sock = s
+        self.port = s.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._serve, name=f"peer_snap:{self.rank}",
+            daemon=True)
+        self._thread.start()
+        if self.kv is not None:
+            self.kv.put_json(f"addr/{self.rank}",
+                             {"host": self.host, "port": self.port})
+        return self
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- server ----------------------------------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with conn:
+                    conn.settimeout(10.0)
+                    self._handle(conn)
+            except Exception:       # noqa: BLE001 — a malformed frame
+                pass                # must not kill the server thread
+
+    def _handle(self, conn):
+        hdr_len = len(_PEER_MAGIC) + struct.calcsize(_PEER_HDR)
+        hdr = _recv_exact(conn, hdr_len)
+        if not hdr.startswith(_PEER_MAGIC):
+            raise CheckpointCorrupt("peer snapshot: bad frame magic")
+        cmd, from_rank, step, epoch, crc, nbytes = struct.unpack(
+            _PEER_HDR, hdr[len(_PEER_MAGIC):])
+        if cmd == _PEER_PUT:
+            blob = _recv_exact(conn, nbytes)
+            if zlib.crc32(blob) & 0xffffffff != crc:
+                raise CheckpointCorrupt(
+                    f"peer snapshot from rank {from_rank} step {step}: "
+                    f"checksum mismatch in transit")
+            self._store(from_rank, step, epoch, blob)
+            telemetry.count("peer_snap.recvs")
+            conn.sendall(b"OK")
+        elif cmd == _PEER_GET:
+            with self._lock:
+                held = self._held.get(from_rank, {}).get(step)
+            if held is None:
+                conn.sendall(struct.pack("<BIQ", 0, 0, 0))
+                return
+            blob = held[1]
+            conn.sendall(struct.pack(
+                "<BIQ", 1, zlib.crc32(blob) & 0xffffffff, len(blob)))
+            conn.sendall(blob)
+        else:
+            raise CheckpointCorrupt(f"peer snapshot: unknown cmd {cmd}")
+
+    def _store(self, from_rank, step, epoch, blob):
+        now = time.monotonic()
+        with self._lock:
+            d = self._held.setdefault(int(from_rank), {})
+            d[int(step)] = (int(epoch), blob, now)
+            while len(d) > self.keep:
+                oldest = min(d)
+                if now - d[oldest][2] <= self.retain_s:
+                    break       # still inside the detection window
+                del d[oldest]
+            # advertise only the steps from THIS epoch: a pre-reshape
+            # snapshot must never be offered as a restore point for the
+            # reshaped gang (its shard set matches the old membership)
+            steps = sorted(s for s, (e, _, _) in d.items()
+                           if e == int(epoch))
+        if self.kv is not None:
+            self.kv.put_json(f"held/{self.rank}/{int(from_rank)}",
+                             {"steps": steps, "epoch": int(epoch)})
+
+    # -- local holds -----------------------------------------------------------
+
+    def hold_own(self, step, state, epoch=0):
+        """Keep this rank's own snapshot in RAM (served to peers during
+        THEIR recovery, and our own rollback source)."""
+        blob = pickle.dumps(snapshot_to_host(state), protocol=4)
+        self._store(self.rank, step, epoch, blob)
+
+    def own_at(self, step):
+        with self._lock:
+            held = self._held.get(self.rank, {}).get(int(step))
+        return pickle.loads(held[1]) if held is not None else None
+
+    def held_steps(self, from_rank, epoch=None):
+        with self._lock:
+            d = self._held.get(int(from_rank), {})
+            if epoch is None:
+                return sorted(d)
+            return sorted(s for s, (e, _, _) in d.items()
+                          if e == int(epoch))
+
+    # -- client ----------------------------------------------------------------
+
+    def _addr_of(self, rank):
+        if self.kv is None:
+            return None
+        return self.kv.get_json(f"addr/{rank}")
+
+    def send_to(self, peer_rank, step, state, epoch=0, timeout=5.0):
+        """Push a snapshot into ``peer_rank``'s RAM.  Best-effort: a
+        busy/restarting buddy costs this snapshot its replica, never the
+        training step — returns False instead of raising."""
+        addr = self._addr_of(peer_rank)
+        if not addr:
+            return False
+        blob = pickle.dumps(snapshot_to_host(state), protocol=4)
+        frame = _PEER_MAGIC + struct.pack(
+            _PEER_HDR, _PEER_PUT, self.rank, int(step), int(epoch),
+            zlib.crc32(blob) & 0xffffffff, len(blob))
+        try:
+            with socket.create_connection(
+                    (addr["host"], addr["port"]), timeout=timeout) as c:
+                c.sendall(frame)
+                c.sendall(blob)
+                ok = _recv_exact(c, 2) == b"OK"
+        except (OSError, KeyError):
+            return False
+        if ok:
+            telemetry.count("peer_snap.sends")
+            telemetry.count("peer_snap.sent_bytes", len(blob))
+        return ok
+
+    def fetch(self, holder_rank, from_rank, step, timeout=5.0):
+        """Pull ``from_rank``'s snapshot at ``step`` out of
+        ``holder_rank``'s RAM; None when the holder doesn't have it.
+        CRC-validated — raises CheckpointCorrupt on a torn transfer."""
+        if holder_rank == self.rank:
+            return self.own_at(step) if from_rank == self.rank else \
+                self._local_fetch(from_rank, step)
+        addr = self._addr_of(holder_rank)
+        if not addr:
+            return None
+        frame = _PEER_MAGIC + struct.pack(
+            _PEER_HDR, _PEER_GET, int(from_rank), int(step), 0, 0, 0)
+        try:
+            with socket.create_connection(
+                    (addr["host"], addr["port"]), timeout=timeout) as c:
+                c.sendall(frame)
+                found, crc, nbytes = struct.unpack(
+                    "<BIQ", _recv_exact(c, 13))
+                if not found:
+                    return None
+                blob = _recv_exact(c, nbytes)
+        except (OSError, KeyError):
+            return None
+        if zlib.crc32(blob) & 0xffffffff != crc:
+            raise CheckpointCorrupt(
+                f"peer snapshot rank {from_rank} step {step} from "
+                f"holder {holder_rank}: checksum mismatch")
+        telemetry.count("peer_snap.fetches")
+        return pickle.loads(blob)
+
+    def _local_fetch(self, from_rank, step):
+        with self._lock:
+            held = self._held.get(int(from_rank), {}).get(int(step))
+        return pickle.loads(held[1]) if held is not None else None
 
 
 def _apply_template(state, template, path="$"):

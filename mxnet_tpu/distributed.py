@@ -17,10 +17,25 @@ exponential backoff under ``MXTPU_RENDEZVOUS_RETRIES`` attempts /
 ``MXTPU_RENDEZVOUS_TIMEOUT`` seconds total; ``distributed.barrier`` arms
 a watchdog from ``MXTPU_COLLECTIVE_TIMEOUT`` so a dead peer produces a
 stack dump and a clean error instead of an infinite hang.
+
+Elastic gang plane (PR 8): a small key-value control plane the health
+plane (`resilience.HeartbeatPublisher` / `FailureDetector`) and the
+membership protocol (`resilience.ElasticGang`) publish through.  Two
+transports behind one ``put/get/scan/delete`` surface:
+
+- :class:`FileKV` — a shared directory (``MXTPU_GANG_DIR``), atomic
+  rename writes.  Survives any member's death, needs no coordinator,
+  and is what the hermetic single-host gangs (tools/launch.py local
+  launcher, the multi-process tests) use.
+- :class:`CoordKV` — the jax coordination-service key-value store (the
+  same gRPC plane `barrier` uses), for real multi-host pods.
+
+`gang_kv()` picks the transport.
 """
 
 from __future__ import annotations
 
+import json
 import os
 
 from . import resilience
@@ -133,3 +148,165 @@ def barrier(name="mxtpu_barrier"):
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(name)
+
+
+# ---------------------------------------------------------------------------
+# Elastic gang control plane (PR 8).
+#
+# The health plane must keep working *while a member is dead*, which the
+# coordination-service barrier above cannot do (wait_at_barrier blocks on
+# the dead peer).  So membership state lives in a plain KV store with no
+# fate-sharing: writes are per-rank, reads never block on a peer.
+
+
+class FileKV:
+    """Shared-directory key-value store with atomic rename writes.
+
+    Keys are slash-separated paths (``hb/0``, ``epoch/current``,
+    ``epoch_ack/3/1``); values are bytes.  A write is tmp-file + rename,
+    so readers see either the old or the new value, never a torn one.
+    No locks, no daemons: any member (or an outside supervisor like
+    ``tools/launch.py --elastic``) can read the gang's state at any
+    time, including after every member is dead.
+    """
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key):
+        if ".." in key.split("/"):
+            raise ValueError(f"bad kv key: {key!r}")
+        return os.path.join(self.root, *key.split("/"))
+
+    def put(self, key, value):
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get(self, key, default=None):
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, NotADirectoryError):
+            return default
+
+    def scan(self, prefix):
+        """All (key, value) pairs under ``prefix`` (non-recursive)."""
+        base = self._path(prefix)
+        try:
+            names = sorted(os.listdir(base))
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        out = []
+        for name in names:
+            if name.startswith(".") or ".tmp." in name:
+                continue
+            full = os.path.join(base, name)
+            if not os.path.isfile(full):
+                continue
+            try:
+                with open(full, "rb") as f:
+                    out.append((f"{prefix}/{name}", f.read()))
+            except FileNotFoundError:
+                continue
+        return out
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    # JSON convenience layer — everything the gang publishes is JSON.
+    def put_json(self, key, obj):
+        self.put(key, json.dumps(obj, sort_keys=True))
+
+    def get_json(self, key, default=None):
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return default
+
+
+class CoordKV:
+    """KV plane over the jax coordination service (multi-host pods).
+
+    Best-effort: the coordination service dies with rank 0's process, so
+    this transport only covers failures of non-coordinator ranks.  Real
+    deployments that need full coverage point MXTPU_GANG_DIR at a shared
+    filesystem (or future: an external store) instead.
+    """
+
+    def __init__(self, client):
+        self._client = client
+
+    def put(self, key, value):
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        self._client.key_value_set(f"mxtpu_gang/{key}", value,
+                                   allow_overwrite=True)
+
+    def get(self, key, default=None):
+        getter = getattr(self._client, "key_value_try_get", None)
+        if getter is None:
+            return default
+        try:
+            return getter(f"mxtpu_gang/{key}").encode("utf-8")
+        except Exception:
+            return default
+
+    def scan(self, prefix):
+        getter = getattr(self._client, "key_value_dir_get", None)
+        if getter is None:
+            return []
+        try:
+            pairs = getter(f"mxtpu_gang/{prefix}/")
+        except Exception:
+            return []
+        out = []
+        for key, value in pairs:
+            if key.startswith("mxtpu_gang/"):
+                key = key[len("mxtpu_gang/"):]
+            out.append((key.rstrip("/"), value.encode("utf-8")))
+        return out
+
+    def delete(self, key):
+        try:
+            self._client.key_value_delete(f"mxtpu_gang/{key}")
+        except Exception:
+            pass
+
+    def put_json(self, key, obj):
+        self.put(key, json.dumps(obj, sort_keys=True))
+
+    def get_json(self, key, default=None):
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return default
+
+
+def gang_kv():
+    """The elastic control plane's KV transport, or None when elastic
+    recovery has nowhere to publish (no gang dir, not distributed)."""
+    root = os.environ.get("MXTPU_GANG_DIR")
+    if root:
+        return FileKV(root)
+    client = _coordination_client()
+    if client is not None and hasattr(client, "key_value_set"):
+        return CoordKV(client)
+    return None

@@ -43,14 +43,32 @@ def _bucket_bytes():
 
 _ALLREDUCE_CACHE = {}
 
+#: elastic membership epoch (bumped by `notify_mesh_reshape`): part of
+#: every compiled-program fingerprint, because an N→M gang reshape can
+#: leave jax's visible device set unchanged on a survivor while the
+#: cross-process collective topology it compiled against is gone.
+_MESH_EPOCH = 0
+
+
+def notify_mesh_reshape(epoch):
+    """Called by `resilience.ElasticGang.recover` after a membership
+    change: invalidates every cached all-reduce program (and, through
+    `device_fingerprint`, every captured whole-step program) so the
+    first post-reshape step retraces against the new topology."""
+    global _MESH_EPOCH
+    _MESH_EPOCH = int(epoch)
+    _ALLREDUCE_CACHE.clear()
+
 
 def _device_fingerprint():
-    """Cache key component: the current global device set.  Invalidates
-    compiled all-reduce programs if the set changes across a
-    preemption/restart (the §5.3 recovery story)."""
+    """Cache key component: the current global device set plus the
+    elastic membership epoch.  Invalidates compiled all-reduce programs
+    if either changes across a preemption/restart or a gang reshape
+    (the §5.3 recovery story)."""
     import jax
 
-    return tuple(sorted((d.process_index, d.id) for d in jax.devices()))
+    return (_MESH_EPOCH,) + tuple(
+        sorted((d.process_index, d.id) for d in jax.devices()))
 
 
 def device_fingerprint():

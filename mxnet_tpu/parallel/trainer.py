@@ -423,6 +423,32 @@ class ShardedTrainer:
 
         return checkpoint.trainer_state_template(self)
 
+    def reshape_mesh(self, mesh=None):
+        """Re-lay this trainer onto a new mesh (the elastic N→M reshape,
+        `resilience.ElasticGang`).
+
+        After a gang membership change the device topology the step
+        program compiled against is gone; this snapshots the full train
+        state to host, rebuilds the mesh (default: a fresh
+        data-parallel mesh over the CURRENT device set), recomputes the
+        shardings, re-places every buffer, and recompiles the step —
+        state values are preserved exactly, so the post-reshape loss
+        trajectory matches a fresh trainer restored from the same
+        snapshot."""
+        if not self._initialized:
+            self.mesh = mesh if mesh is not None else data_parallel_mesh()
+            return self
+        from .. import checkpoint
+
+        state = checkpoint.trainer_state(self)
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self._param_shardings = [param_sharding(p, self.mesh)
+                                 for _, p in self._trainable]
+        checkpoint.load_trainer_state(self, state)
+        self._step_fn = None
+        self._build_step()
+        return self
+
     def sync_params(self):
         """Write the mesh-resident values back into the gluon Parameters
         (handle swap, no host transfer)."""

@@ -57,10 +57,27 @@ Fault-injection sites (``MXTPU_FAULT_INJECT="site:arg,site:arg"``):
                           before the manifest commit rename
 - ``corrupt_shard:K``    — flip bytes in shard K of the checkpoint that
                           was just committed
+- ``corrupt_ckpt_write:N`` — bit-rot the next N committed
+                          LocalCheckpointer files (verify-after-write
+                          must catch them)
+- ``kill_rank:K``        — SIGKILL this process when it IS gang rank K
+                          (optionally gated on ``MXTPU_KILL_AT_STEP``);
+                          repeatable: ``kill_rank:1,kill_rank:2``
+- ``slow_rank:K``        — rank K sleeps ``MXTPU_SLOW_RANK_SECS`` per
+                          step tick (straggler injection)
+- ``heartbeat_loss:K``   — rank K stops publishing heartbeats while the
+                          process keeps running (the wedged-alive mode)
+
+Elastic gang recovery (PR 8) also lives here: :class:`HeartbeatPublisher`
+/ :class:`FailureDetector` / :class:`StragglerMonitor` form the health
+plane over ``distributed.gang_kv()``, and :class:`ElasticGang` runs the
+epoch-consensus reshape protocol that lets survivors shrink N→M (and
+grow back) without a gang restart.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import pickle
@@ -111,6 +128,7 @@ class _FaultPlan:
         self.spec = spec
         self.counts = {}   # site -> remaining trigger count
         self.args = {}     # site -> numeric arg (step index, seconds, ...)
+        self.list_args = {}  # site -> [rank, ...] (repeatable rank sites)
         for item in (spec or "").split(","):
             item = item.strip()
             if not item:
@@ -118,18 +136,26 @@ class _FaultPlan:
             site, _, arg = item.partition(":")
             if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
                         "crash_during_save", "crash_before_manifest",
-                        "telemetry_crash"):
+                        "telemetry_crash", "corrupt_ckpt_write"):
                 # nan_grad: poison one gradient with NaN before health
                 # assessment (consumed by the Trainer's numerics guard);
                 # inf_loss: corrupt the loss seen by
                 # numerics.DivergenceMonitor.observe;
                 # telemetry_crash: kill the process mid-JSONL-append
-                # (telemetry._emit) to prove the log stays parseable
+                # (telemetry._emit) to prove the log stays parseable;
+                # corrupt_ckpt_write: bit-rot the next N committed
+                # LocalCheckpointer files (verify-after-write coverage)
                 self.counts[site] = int(arg) if arg else 1
             elif site in ("corrupt_record", "sigterm_at_step",
                           "corrupt_shard"):
                 self.args[site] = int(arg) if arg else 0
                 self.counts[site] = 1
+            elif site in ("kill_rank", "slow_rank", "heartbeat_loss"):
+                # rank-targeted sites: repeatable ("kill_rank:1,
+                # kill_rank:2"), persistent conditions (no counter) —
+                # each process checks its OWN gang rank against the list
+                self.list_args.setdefault(site, []).append(
+                    int(arg) if arg else 0)
             elif site in ("stall_collective", "stall"):
                 self.args["stall_collective"] = float(arg) if arg else 3600.0
                 self.counts["stall_collective"] = 1
@@ -189,6 +215,13 @@ def fault_arg(site):
     return None if plan is None else plan.arg(site)
 
 
+def fault_args(site):
+    """All arguments of a repeatable rank-targeted site (kill_rank /
+    slow_rank / heartbeat_loss), as a tuple; empty when unarmed."""
+    plan = _plan()
+    return () if plan is None else tuple(plan.list_args.get(site, ()))
+
+
 def consume_fault(site):
     """True once per armed count for the site (non-raising variant)."""
     plan = _plan()
@@ -234,6 +267,30 @@ def maybe_stall(site="stall_collective"):
         time.sleep(0.05)
 
 
+def maybe_kill_rank(rank, step=None):
+    """``kill_rank:K``: SIGKILL this process when its gang rank is K —
+    no cleanup, no atexit, no SIGTERM grace.  ``MXTPU_KILL_AT_STEP``
+    (when set AND a step is supplied) gates the kill to one exact step,
+    so the multi-process tests control precisely which snapshots exist
+    when the rank dies."""
+    if rank not in fault_args("kill_rank"):
+        return
+    at = os.environ.get("MXTPU_KILL_AT_STEP")
+    if at is not None and step is not None and int(at) != int(step):
+        return
+    sys.stderr.write(f"[resilience] injected kill_rank: SIGKILL rank "
+                     f"{rank} at step {step}\n")
+    sys.stderr.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_slow_rank(rank):
+    """``slow_rank:K``: rank K sleeps MXTPU_SLOW_RANK_SECS (0.2) per
+    step tick — a persistent straggler the StragglerMonitor must name."""
+    if rank in fault_args("slow_rank"):
+        time.sleep(float(os.environ.get("MXTPU_SLOW_RANK_SECS", "0.2")))
+
+
 # -- durable IO ----------------------------------------------------------------
 
 def fsync_dir(path):
@@ -260,13 +317,21 @@ def fsync_dir(path):
 # -- retry primitive -----------------------------------------------------------
 
 def retry_call(fn, *, retries=3, deadline=None, backoff=0.1,
-               max_backoff=5.0, jitter=0.5, retryable=(Exception,),
+               max_backoff=5.0, jitter=True, retryable=(Exception,),
                non_retryable=(), on_retry=None, description=None):
     """Call ``fn()`` with exponential-backoff-with-jitter retries.
 
     - ``retries``: max retry count (total attempts = retries + 1)
     - ``deadline``: total wall-clock budget in seconds; a retry whose
       backoff sleep would overshoot the deadline raises instead
+    - ``jitter``: on by default — DECORRELATED jitter: each sleep is
+      ``uniform(backoff, 3 * previous_sleep)`` capped at ``max_backoff``,
+      so N workers retrying after one gang-wide incident (say, every
+      survivor re-rendezvousing at once) spread out instead of hammering
+      the coordinator in lockstep at the same exponential marks.  Falsy
+      disables it (deterministic exponential — what the timing tests
+      pin); a float keeps the legacy proportional scheme
+      (``exponential * (1 + jitter * U[0,1))``).
     - ``retryable``/``non_retryable``: exception classes to retry / to
       re-raise immediately (non_retryable wins)
     - ``on_retry(attempt, exc, sleep_s)``: observer hook
@@ -274,6 +339,7 @@ def retry_call(fn, *, retries=3, deadline=None, backoff=0.1,
     what = description or getattr(fn, "__name__", "call")
     start = time.monotonic()
     attempt = 0
+    prev_sleep = backoff
     while True:
         try:
             return fn()
@@ -282,8 +348,14 @@ def retry_call(fn, *, retries=3, deadline=None, backoff=0.1,
         except retryable as e:
             if attempt >= retries:
                 raise
-            sleep_s = min(max_backoff, backoff * (2 ** attempt))
-            sleep_s *= 1.0 + jitter * _random.random()
+            if jitter is True:
+                sleep_s = min(max_backoff, _random.uniform(
+                    backoff, max(prev_sleep * 3.0, backoff)))
+                prev_sleep = sleep_s
+            else:
+                sleep_s = min(max_backoff, backoff * (2 ** attempt))
+                if jitter:
+                    sleep_s *= 1.0 + float(jitter) * _random.random()
             if deadline is not None and \
                     time.monotonic() - start + sleep_s > deadline:
                 raise MXNetError(
@@ -563,6 +635,14 @@ class LocalCheckpointer:
             # durability: the rename lives in the directory inode — fsync
             # it too, or power loss can roll the commit back
             fsync_dir(self._dir)
+        if consume_fault("corrupt_ckpt_write"):
+            # bit-rot the file AFTER the commit rename: only the
+            # verify-after-write readback (_save_verified) can catch it
+            with open(self._path(step), "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                last = f.read(1)
+                f.seek(-1, os.SEEK_END)
+                f.write(bytes([last[0] ^ 0xFF]))
         self._prune()
         return step
 
@@ -636,13 +716,14 @@ class RunReport:
     def __init__(self):
         self.final_step = 0
         self.restarts = 0
+        self.reshapes = 0        # elastic gang membership changes
         self.resumed_from = []   # checkpoint step of each (re)start
         self.losses = {}         # step -> float loss
         self.preempted = False
 
     def __repr__(self):
         return (f"RunReport(final_step={self.final_step}, "
-                f"restarts={self.restarts}, "
+                f"restarts={self.restarts}, reshapes={self.reshapes}, "
                 f"resumed_from={self.resumed_from}, "
                 f"preempted={self.preempted})")
 
@@ -658,11 +739,15 @@ def flush_inflight(checkpointer, logger=None):
     wait = getattr(checkpointer, "wait", None)
     if wait is None:
         return
+    pending = getattr(checkpointer, "pending_step", None)
     try:
         wait()
     except Exception as e:                      # noqa: BLE001
         _log(logger, f"in-flight checkpoint save failed ({e}); "
                      f"recovering from the previous checkpoint")
+        _tel_event("inflight_save_dropped",
+                   step=int(pending) if isinstance(pending, int) else None,
+                   reason=type(e).__name__)
 
 
 def resume_latest(checkpointer, set_state, logger=None):
@@ -681,6 +766,8 @@ def resume_latest(checkpointer, set_state, logger=None):
         except Exception as e:
             _log(logger, f"checkpoint step {step} unreadable ({e}); "
                          f"falling back to the previous one")
+            _tel_event("ckpt_fallback", step=int(step),
+                       reason=type(e).__name__)
             continue
         set_state(state)
         _log(logger, f"resumed from checkpoint step {step}")
@@ -716,7 +803,8 @@ def _save_verified(checkpointer, step, state, logger=None):
 def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                   set_state, checkpoint_every=None, max_restarts=3,
                   watchdog_timeout=None, exit_on_preempt=False,
-                  recover_on=(RuntimeError, OSError), logger=None):
+                  recover_on=(RuntimeError, OSError), logger=None,
+                  gang=None, on_reshape=None):
     """Supervised training loop: auto-resume + preemption checkpointing +
     bounded in-process restarts.
 
@@ -740,6 +828,16 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
     - A step failure in ``recover_on`` (or a watchdog expiry) restores
       the latest valid checkpoint and replays; corrupt checkpoints fall
       back to the previous step.
+    - ``gang`` (an :class:`ElasticGang`): gang-level recovery.  Each
+      step ticks the health plane (heartbeat step ids, peer snapshots,
+      failure-detector poll); a confirmed peer death raises
+      :class:`RankFailure`, which runs ``gang.recover`` — survivors
+      agree a new epoch and keep training — instead of the full-restart
+      path.  ``on_reshape(info)`` merges the recovered per-rank shards
+      back into trainer state and returns the resume step (or a
+      ``(step, new_checkpointer)`` tuple when the reshape rebuilds the
+      checkpoint engine for the new world size); without the callback
+      only disk-sourced recoveries (``info.full_state``) can be applied.
 
     Returns a :class:`RunReport`.
     """
@@ -764,6 +862,34 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
     _tel_event("resume", step=step)
     last_saved = step
     step_box = [step]
+
+    def gang_reshape(rf):
+        """Shared RankFailure handler (step tick, step fn, or a gang-
+        coordinated checkpoint barrier may raise it)."""
+        nonlocal step, checkpointer, is_async, last_saved
+        info = gang.recover(rf, checkpointer=checkpointer)
+        report.reshapes += 1
+        if on_reshape is not None:
+            res = on_reshape(info)
+            if isinstance(res, tuple):
+                step, checkpointer = res
+            else:
+                step = int(res) if res is not None else info.snap_step
+        elif info.full_state is not None:
+            set_state(info.full_state)
+            step = info.snap_step
+        else:
+            raise MXNetError(
+                "run_resilient: gang recovery assembled per-rank peer "
+                "shards; pass on_reshape= to merge them into trainer "
+                "state") from rf
+        is_async = bool(getattr(checkpointer, "async_save", False))
+        last_saved = step
+        step_box[0] = step
+        report.resumed_from.append(step)
+        _log(logger, f"gang reshaped to epoch {info.epoch} (world "
+                     f"{info.world}); resuming at step {step}")
+
     with PreemptionHandler(checkpointer, get_state,
                            lambda: step_box[0]) as handler:
         while step < num_steps:
@@ -791,12 +917,19 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                 _tel_event("restart", step=step, reason="preempted")
                 continue
             try:
+                if gang is not None:
+                    gang.step_tick(step, state_fn=get_state)
                 if watchdog_timeout:
                     with Watchdog(watchdog_timeout,
                                   name=f"step {step}"):
                         loss = step_fn(step)
                 else:
                     loss = step_fn(step)
+            except RankFailure as rf:
+                if gang is None:
+                    raise
+                gang_reshape(rf)
+                continue
             except recover_on as e:
                 if report.restarts >= max_restarts:
                     raise
@@ -816,7 +949,13 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                     pass
             step += 1
             if checkpoint_every and step % checkpoint_every == 0:
-                save_at(step)
+                try:
+                    save_at(step)
+                except RankFailure as rf:
+                    if gang is None:
+                        raise
+                    gang_reshape(rf)   # a peer died inside the gang-
+                    continue           # coordinated commit barrier
                 last_saved = step
         if step > last_saved:
             save_at(step)
@@ -824,3 +963,775 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
             checkpointer.wait()   # the final commit must land before we
     report.final_step = step      # report the run finished
     return report
+
+
+# -- elastic gang recovery (health plane + membership protocol) ----------------
+
+class RankFailure(MXNetError):
+    """A gang membership change is required: peers confirmed dead and/or
+    respawned ranks asking to rejoin.  Raised by `ElasticGang.step_tick`
+    (and gang barriers); the handler calls `ElasticGang.recover`."""
+
+    def __init__(self, dead, epoch, joiners=()):
+        self.dead = sorted(dead)
+        self.joiners = sorted(joiners)
+        self.epoch = int(epoch)
+        what = []
+        if self.dead:
+            what.append(f"dead ranks {self.dead}")
+        if self.joiners:
+            what.append(f"join requests {self.joiners}")
+        super().__init__(
+            f"gang membership change at epoch {epoch}: "
+            f"{', '.join(what) or 'unknown'}")
+
+
+class GangEvicted(MXNetError):
+    """The agreed epoch excludes THIS rank — the survivors declared it
+    dead (a wedge that later unwedged, a partition, a false positive).
+    The only safe move is a clean exit: rejoining with stale state would
+    corrupt the reshaped gang.  Workers treat this as exit code 0."""
+
+
+class HeartbeatPublisher:
+    """Per-rank liveness beacon: a daemon thread publishes
+    ``hb/<rank> = {rank, seq, step, t}`` to the gang KV every
+    ``MXTPU_HEARTBEAT_INTERVAL`` (0.5s).  ``seq`` is what the failure
+    detector watches — strictly monotonic per publish, so a stalled
+    clock or republished file can't fake liveness.  ``note_step`` keeps
+    the payload's step id fresh (the straggler monitor's lag signal).
+
+    The ``heartbeat_loss:K`` fault site suppresses publishing while the
+    process keeps running: the wedged-but-alive failure mode, which must
+    look exactly like death to the detector.
+    """
+
+    def __init__(self, kv, rank, interval=None):
+        self.kv = kv
+        self.rank = int(rank)
+        self.interval = float(
+            os.environ.get("MXTPU_HEARTBEAT_INTERVAL", 0.5)
+            if interval is None else interval)
+        self._step = 0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def note_step(self, step):
+        self._step = int(step)
+
+    def publish_once(self):
+        if self.rank in fault_args("heartbeat_loss"):
+            return
+        self._seq += 1
+        self.kv.put_json(f"hb/{self.rank}",
+                         {"rank": self.rank, "seq": self._seq,
+                          "step": self._step, "t": time.time()})
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.publish_once()
+            except Exception:       # noqa: BLE001 — liveness reporting
+                pass                # must never kill training
+            self._stop.wait(self.interval)
+
+    def start(self):
+        if self._thread is None:
+            self.publish_once()     # visible before the first interval
+            self._thread = threading.Thread(
+                target=self._loop, name=f"heartbeat:{self.rank}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class _PeerHealth:
+    __slots__ = ("seq", "step", "last_change", "arrivals", "suspected")
+
+    def __init__(self, now):
+        self.seq = None
+        self.step = None
+        self.last_change = now
+        self.arrivals = collections.deque(maxlen=32)
+        self.suspected = False
+
+
+class FailureDetector:
+    """Phi-style accrual failure detector over KV heartbeats.
+
+    Suspicion is *accrual*: phi = silence / mean-observed-interarrival,
+    so a peer that heartbeats every 0.1s is suspected after ~1s of
+    silence while a peer on a slow NFS gang dir isn't — the threshold
+    adapts to each peer's own cadence (``MXTPU_PHI_SUSPECT``, 8.0).
+    Suspicion only emits a ``rank_suspected`` telemetry event (once per
+    silence episode); *death* is confirmed by the hard wall-clock
+    timeout ``MXTPU_HEARTBEAT_TIMEOUT`` (5s), which is what the reshape
+    protocol acts on — a deliberately conservative two-level scheme so
+    one GC pause can't trigger a reshard.
+
+    ``poll()`` is throttled to ``check_interval`` (half the heartbeat
+    interval), so calling it every training step costs a dict lookup,
+    not a KV scan.
+    """
+
+    def __init__(self, kv, rank, peers, *, timeout=None,
+                 suspect_phi=None, check_interval=None):
+        self.kv = kv
+        self.rank = int(rank)
+        self.timeout = float(
+            os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", 5.0)
+            if timeout is None else timeout)
+        self.suspect_phi = float(
+            os.environ.get("MXTPU_PHI_SUSPECT", 8.0)
+            if suspect_phi is None else suspect_phi)
+        if check_interval is None:
+            check_interval = float(
+                os.environ.get("MXTPU_HEARTBEAT_INTERVAL", 0.5)) / 2.0
+        self.check_interval = max(1e-3, float(check_interval))
+        self._peers = {}
+        now = time.monotonic()
+        for p in peers:
+            if int(p) != self.rank:
+                self._peers[int(p)] = _PeerHealth(now)
+        self._last_check = 0.0
+        self._dead = set()
+
+    def watch(self, rank):
+        if int(rank) != self.rank and int(rank) not in self._peers:
+            self._peers[int(rank)] = _PeerHealth(time.monotonic())
+        self._dead.discard(int(rank))
+
+    def forget(self, rank):
+        self._peers.pop(int(rank), None)
+        self._dead.discard(int(rank))
+
+    def peer_steps(self):
+        """Last heartbeat-published step id per watched peer (None until
+        the first heartbeat lands)."""
+        return {p: h.step for p, h in self._peers.items()}
+
+    def poll(self, force=False):
+        """Returns the set of CONFIRMED-dead peers (silence beyond the
+        hard timeout).  Throttled; pass force=True to re-read the KV
+        regardless (recovery paths)."""
+        now = time.monotonic()
+        if not force and now - self._last_check < self.check_interval:
+            return set(self._dead)
+        self._last_check = now
+        for p, h in self._peers.items():
+            rec = self.kv.get_json(f"hb/{p}")
+            seq = rec.get("seq") if isinstance(rec, dict) else None
+            if seq is not None and seq != h.seq:
+                if h.seq is not None:
+                    h.arrivals.append(now - h.last_change)
+                h.seq = seq
+                h.step = rec.get("step")
+                h.last_change = now
+                h.suspected = False
+                self._dead.discard(p)
+                continue
+            silence = now - h.last_change
+            mean = (sum(h.arrivals) / len(h.arrivals)) \
+                if h.arrivals else None
+            phi = silence / mean if mean else 0.0
+            if not h.suspected and (phi >= self.suspect_phi
+                                    or silence >= self.timeout / 2.0):
+                h.suspected = True
+                _tel_event("rank_suspected", rank=p,
+                           silence_s=round(silence, 3),
+                           phi=round(phi, 2))
+            if silence >= self.timeout:
+                self._dead.add(p)
+        return set(self._dead)
+
+
+class StragglerMonitor:
+    """Names the slow rank behind persistent collective waits.
+
+    Fed the per-step collective-wait share (telemetry StepStats
+    ``shares["collective"]``): when the mean share over the last
+    ``MXTPU_STRAGGLER_WINDOW`` (20) steps exceeds
+    ``MXTPU_STRAGGLER_SHARE`` (0.5), this rank is mostly waiting for a
+    peer — and the peer whose heartbeat-published step id is furthest
+    behind is the one everyone is waiting on.  Emits a
+    ``straggler_suspected`` event (at most once per window) naming it;
+    detection only — eviction stays a human/provisioner decision, since
+    a straggler still makes progress.
+    """
+
+    def __init__(self, detector, *, window=None, share_threshold=None):
+        self.detector = detector
+        self.window = int(os.environ.get("MXTPU_STRAGGLER_WINDOW", 20)
+                          if window is None else window)
+        self.share_threshold = float(
+            os.environ.get("MXTPU_STRAGGLER_SHARE", 0.5)
+            if share_threshold is None else share_threshold)
+        self._shares = collections.deque(maxlen=max(1, self.window))
+        self._last_emit_step = None
+
+    def observe(self, step, collective_share):
+        """Returns the suspected rank when one is (newly) named."""
+        if collective_share is None:
+            return None
+        self._shares.append(float(collective_share))
+        if len(self._shares) < self.window:
+            return None
+        mean = sum(self._shares) / len(self._shares)
+        if mean < self.share_threshold:
+            return None
+        if self._last_emit_step is not None and \
+                step - self._last_emit_step < self.window:
+            return None
+        steps = {p: s for p, s in self.detector.peer_steps().items()
+                 if s is not None and s <= step}
+        if not steps:
+            return None
+        laggard = min(steps, key=steps.get)
+        self._last_emit_step = step
+        _tel_event("straggler_suspected", rank=laggard, step=int(step),
+                   mean_collective_share=round(mean, 3),
+                   laggard_step=int(steps[laggard]))
+        return laggard
+
+
+class RecoveryInfo:
+    """What `ElasticGang.recover` agreed and assembled."""
+
+    def __init__(self, *, epoch, members, snap_step, source, dead,
+                 joined, recovery_ms, shards=None, full_state=None,
+                 old_members=()):
+        self.epoch = int(epoch)
+        self.members = list(members)
+        self.snap_step = int(snap_step)
+        self.source = source            # "peer" | "disk"
+        self.dead = sorted(dead)
+        self.joined = sorted(joined)
+        self.recovery_ms = float(recovery_ms)
+        self.shards = shards            # {old_rank: shard state} (peer)
+        self.full_state = full_state    # full pytree (disk)
+        self.old_members = list(old_members)
+
+    @property
+    def world(self):
+        return len(self.members)
+
+    def __repr__(self):
+        return (f"RecoveryInfo(epoch={self.epoch}, "
+                f"members={self.members}, snap_step={self.snap_step}, "
+                f"source={self.source!r}, dead={self.dead}, "
+                f"joined={self.joined}, "
+                f"recovery_ms={self.recovery_ms:.1f})")
+
+
+class ElasticGang:
+    """The elastic membership runtime one rank participates in.
+
+    Composes the health plane (heartbeats out, failure detection in,
+    straggler naming) with peer-replicated RAM snapshots
+    (`checkpoint.PeerSnapshotStore`) and the epoch-consensus reshape
+    protocol.  The control plane is `distributed.gang_kv()` — a shared
+    directory (``MXTPU_GANG_DIR``) or the coordination-service KV —
+    chosen for exactly one property the collective plane lacks: it
+    keeps working while a member is dead.
+
+    Protocol sketch (docs/resilience.md has the full diagram)::
+
+        steady state   every rank:  hb/<r> <- {seq, step}        (0.5 s)
+                       every PEER_SNAP_EVERY steps:
+                           own shard -> buddy's RAM  (+ hold own)
+                           snap/<r> <- {step, epoch}
+        death          detector: silence(hb/<k>) > TIMEOUT
+                       survivors raise RankFailure -> recover():
+                         min(survivors) proposes epoch/current <-
+                           {epoch+1, members, dead, snap_step, source}
+                         all new members ack epoch_ack/<e>/<r>
+                         shards assembled: own RAM + live peers' RAM +
+                           dead ranks' shards from their buddies' RAM;
+                           disk manifest (PR 5) only when a buddy died
+                       training resumes at snap_step, epoch e+1
+        rejoin         respawned rank: join_req/<r>; proposer admits at
+                       the next epoch; everyone rolls back to the agreed
+                       snapshot, joiner fetches all shards from peers
+
+    ``step_tick`` raises :class:`RankFailure` (membership change needed)
+    or :class:`GangEvicted` (this rank was declared dead); the caller —
+    `run_resilient(gang=...)` or a bespoke train loop — runs
+    ``recover`` and continues from the returned :class:`RecoveryInfo`.
+    """
+
+    def __init__(self, rank, world, *, kv=None, peers=None,
+                 heartbeat_interval=None, heartbeat_timeout=None,
+                 peer_snap_every=None, reshape_timeout=None,
+                 checkpointer=None):
+        if kv is None:
+            from . import distributed
+
+            kv = distributed.gang_kv()
+        if kv is None:
+            raise MXNetError(
+                "ElasticGang needs a control plane: set MXTPU_GANG_DIR "
+                "to a shared directory (or run under a coordination "
+                "service)")
+        self.kv = kv
+        self.rank = int(rank)
+        self.members = list(range(int(world)))
+        self.epoch = 0
+        self.checkpointer = checkpointer
+        self.peer_snap_every = int(
+            os.environ.get("MXTPU_PEER_SNAP_EVERY", 10)
+            if peer_snap_every is None else peer_snap_every)
+        self.reshape_timeout = float(
+            os.environ.get("MXTPU_RESHAPE_TIMEOUT", 60.0)
+            if reshape_timeout is None else reshape_timeout)
+        self.hb = HeartbeatPublisher(kv, rank,
+                                     interval=heartbeat_interval)
+        self.detector = FailureDetector(kv, rank, self.members,
+                                        timeout=heartbeat_timeout)
+        self.straggler = StragglerMonitor(self.detector)
+        if peers is None:
+            from .checkpoint import PeerSnapshotStore
+
+            peers = PeerSnapshotStore(rank, kv=kv)
+        self.peers = peers
+        self._last_snap_step = None
+        self._started = False
+
+    # -- membership helpers ----------------------------------------------------
+
+    def buddy_of(self, rank, members=None):
+        """The next member ring-wise — who holds ``rank``'s RAM shard."""
+        m = members if members is not None else self.members
+        i = m.index(rank)
+        return m[(i + 1) % len(m)]
+
+    def _is_proposer(self, survivors=None):
+        alive = survivors if survivors is not None else self.members
+        return alive and self.rank == min(alive)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self):
+        if self._started:
+            return self
+        self.peers.start()
+        cur = self.kv.get_json("epoch/current")
+        if cur is None and self._is_proposer():
+            self.kv.put_json("epoch/current",
+                             {"epoch": 0, "members": self.members,
+                              "dead": [], "joined": [],
+                              "proposer": self.rank, "t": time.time()})
+        elif cur is not None and int(cur.get("epoch", 0)) >= self.epoch \
+                and self.rank in cur.get("members", []):
+            self.epoch = int(cur["epoch"])
+            self.members = list(cur["members"])
+            self.detector = FailureDetector(
+                self.kv, self.rank, self.members,
+                timeout=self.detector.timeout)
+            self.straggler.detector = self.detector
+        self.hb.start()
+        self._started = True
+        return self
+
+    def stop(self):
+        self.hb.stop()
+        self.peers.close()
+        self._started = False
+
+    # -- per-step health tick --------------------------------------------------
+
+    def step_tick(self, step, state=None, state_fn=None,
+                  collective_share=None):
+        """Call once per training step (cheap: throttled KV reads).
+
+        Publishes the step id, takes the periodic peer snapshot (from
+        ``state`` or lazily from ``state_fn()``), feeds the straggler
+        monitor, and raises :class:`RankFailure` on a confirmed peer
+        death / pending join, or :class:`GangEvicted` when a newer epoch
+        excludes this rank.
+        """
+        maybe_slow_rank(self.rank)
+        maybe_kill_rank(self.rank, step)
+        self.hb.note_step(step)
+        if self.peer_snap_every and step % self.peer_snap_every == 0 \
+                and step != self._last_snap_step:
+            if state is None and state_fn is not None:
+                state = state_fn()
+            if state is not None:
+                self.snapshot(step, state)
+        self.straggler.observe(step, collective_share)
+        self._check_epoch()
+        dead = self.detector.poll() & set(self.members)
+        dead.discard(self.rank)
+        if dead:
+            raise RankFailure(dead, self.epoch)
+        if self._is_proposer():
+            joiners = self._pending_joiners()
+            if joiners:
+                raise RankFailure((), self.epoch, joiners=joiners)
+
+    def snapshot(self, step, state):
+        """RAM-replicate this rank's shard of ``state``: hold our own
+        copy and ship one to the buddy; advertise the step in the KV so
+        a future proposal can pick a common restore point."""
+        self._last_snap_step = step
+        self.peers.hold_own(step, state, epoch=self.epoch)
+        buddy = self.buddy_of(self.rank)
+        if buddy != self.rank:
+            self.peers.send_to(buddy, step, state, epoch=self.epoch)
+        self.kv.put_json(
+            f"snap/{self.rank}",
+            {"step": int(step),
+             "steps": self.peers.held_steps(self.rank,
+                                            epoch=self.epoch),
+             "epoch": self.epoch})
+
+    def _check_epoch(self):
+        cur = self.kv.get_json("epoch/current")
+        if not cur or int(cur.get("epoch", 0)) <= self.epoch:
+            return
+        if self.rank not in cur.get("members", []):
+            raise GangEvicted(
+                f"rank {self.rank}: epoch {cur['epoch']} members "
+                f"{cur.get('members')} exclude this rank (declared "
+                f"dead); exiting cleanly")
+        raise RankFailure(cur.get("dead", []), self.epoch,
+                          joiners=cur.get("joined", []))
+
+    def _pending_joiners(self):
+        joiners = []
+        for key, _ in self.kv.scan("join_req"):
+            rec = self.kv.get_json(key)
+            r = rec.get("rank") if isinstance(rec, dict) else None
+            if r is not None and r not in self.members:
+                joiners.append(int(r))
+        return sorted(set(joiners))
+
+    # -- gang barrier ----------------------------------------------------------
+
+    def barrier(self, name, timeout=None):
+        """KV-plane barrier that stays responsive to member death: a
+        dead peer raises :class:`RankFailure` instead of hanging (unlike
+        the coordination-service barrier, which fate-shares)."""
+        self.kv.put_json(f"barrier/{self.epoch}/{name}/{self.rank}",
+                         {"rank": self.rank, "t": time.time()})
+        deadline = time.monotonic() + (timeout or self.reshape_timeout)
+        want = set(self.members)
+        while True:
+            present = set()
+            for key, _ in self.kv.scan(f"barrier/{self.epoch}/{name}"):
+                try:
+                    present.add(int(key.rsplit("/", 1)[1]))
+                except ValueError:
+                    pass
+            if want <= present:
+                return
+            self._check_epoch()
+            dead = self.detector.poll() & want
+            dead.discard(self.rank)
+            if dead:
+                raise RankFailure(dead, self.epoch)
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"gang barrier {name!r} (epoch {self.epoch}): "
+                    f"missing ranks {sorted(want - present)} after "
+                    f"{timeout or self.reshape_timeout}s")
+            time.sleep(0.01)
+
+    # -- reshape protocol ------------------------------------------------------
+
+    def recover(self, failure=None, checkpointer=None):
+        """Run the epoch-consensus reshape and assemble the restore
+        state.  Returns a :class:`RecoveryInfo`; the caller re-partitions
+        its trainer state from ``info.shards`` (peer source) or
+        ``info.full_state`` (disk source) and resumes at
+        ``info.snap_step``."""
+        t0 = time.monotonic()
+        ck = checkpointer or self.checkpointer
+        dead = set(failure.dead) if failure is not None else set()
+        joiners = set(failure.joiners) if failure is not None else set()
+        old_members = list(self.members)
+        proposal = self._await_proposal(dead, joiners, ck)
+        epoch = int(proposal["epoch"])
+        new_members = [int(r) for r in proposal["members"]]
+        if self.rank not in new_members:
+            raise GangEvicted(
+                f"rank {self.rank}: reshape to epoch {epoch} excludes "
+                f"this rank; exiting cleanly")
+        old_members = [int(r) for r in
+                       proposal.get("old_members", old_members)]
+        dead = set(int(r) for r in proposal.get("dead", []))
+        joined = [int(r) for r in proposal.get("joined", [])]
+        self.kv.put_json(f"epoch_ack/{epoch}/{self.rank}",
+                         {"rank": self.rank, "t": time.time()})
+        self._await_acks(epoch, new_members)
+        cur = self.kv.get_json("epoch/current") or {}
+        if int(cur.get("epoch", -1)) == epoch and \
+                sorted(int(r) for r in cur.get("members", [])) \
+                != sorted(new_members):
+            # amended in place: a proposed member died before acking
+            new_members = [int(r) for r in cur["members"]]
+            dead = set(int(r) for r in cur.get("dead", []))
+            joined = [int(r) for r in cur.get("joined", [])]
+            if self.rank not in new_members:
+                raise GangEvicted(
+                    f"rank {self.rank}: epoch {epoch} was amended to "
+                    f"exclude this rank; exiting cleanly")
+        source = proposal.get("source", "disk")
+        snap_step = int(proposal["snap_step"])
+        shards = None
+        full_state = None
+        if source == "peer":
+            shards = self._assemble_shards(snap_step, old_members, dead)
+            if shards is None:
+                source = "disk"     # a holder vanished under us
+        if source == "disk":
+            if ck is None:
+                raise MXNetError(
+                    "elastic recovery needs the disk manifest (no RAM "
+                    "coverage) but no checkpointer is attached")
+            disk_step = proposal.get("disk_step")
+            snap_step = int(disk_step if disk_step is not None
+                            else ck.latest_step())
+            full_state = ck.restore(snap_step)
+            _tel_count("elastic.disk_restores")
+        # adopt the new membership
+        self.epoch = epoch
+        self.members = new_members
+        for d in dead:
+            self.detector.forget(d)
+        for j in joined:
+            self.detector.watch(j)
+        self._last_snap_step = None
+        # invalidate cached collective/captured programs — but only when
+        # the kvstore module is actually loaded (importing it would pull
+        # jax into a jax-free hermetic gang, and with no module loaded
+        # there are no cached programs to invalidate)
+        _kvstore = sys.modules.get((__package__ or "mxnet_tpu")
+                                   + ".kvstore")
+        if _kvstore is not None:
+            try:
+                _kvstore.notify_mesh_reshape(epoch)
+            except Exception:       # noqa: BLE001 — best-effort
+                pass
+        ms = (time.monotonic() - t0) * 1000.0
+        for d in sorted(dead):
+            _tel_event("rank_dead", rank=d, epoch=epoch)
+        for j in sorted(joined):
+            _tel_event("rank_rejoin", rank=j, epoch=epoch)
+        _tel_event("mesh_reshape", epoch=epoch, world=len(new_members),
+                   members=new_members, step=snap_step)
+        _tel_event("elastic_recover", epoch=epoch, step=snap_step,
+                   source=source, recovery_ms=round(ms, 2))
+        sys.stderr.write(
+            f"[resilience] rank {self.rank}: gang reshaped to epoch "
+            f"{epoch} world {len(new_members)} (source={source}, "
+            f"snap_step={snap_step}, {ms:.0f} ms)\n")
+        return RecoveryInfo(epoch=epoch, members=new_members,
+                            snap_step=snap_step, source=source,
+                            dead=dead, joined=joined, recovery_ms=ms,
+                            shards=shards, full_state=full_state,
+                            old_members=old_members)
+
+    def join(self, timeout=None):
+        """A (re)spawned rank asks the running gang for admission.
+
+        Publishes ``join_req/<rank>``, waits for the proposer to admit
+        it in a new epoch, then runs the shared ``recover`` path (ack,
+        fetch every old member's shard from live RAM holders — the
+        joiner has none of its own).  Returns the :class:`RecoveryInfo`
+        to resume from, or None when the gang is fresh (nothing to
+        join)."""
+        self.start()    # writes/adopts the epoch record for fresh gangs
+        cur = self.kv.get_json("epoch/current")
+        if cur is None or self.rank in cur.get("members", []):
+            # fresh gang (or a relaunch before any reshape): start()
+            # already adopted the current epoch/membership
+            return None
+        self.kv.put_json(f"join_req/{self.rank}",
+                         {"rank": self.rank, "t": time.time()})
+        deadline = time.monotonic() + (timeout or self.reshape_timeout)
+        while True:
+            cur = self.kv.get_json("epoch/current") or {}
+            if self.rank in cur.get("members", []):
+                break
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"rank {self.rank}: join request not admitted "
+                    f"within {timeout or self.reshape_timeout}s")
+            time.sleep(0.05)
+        # participate in the admitting epoch's recover flow
+        self.epoch = int(cur["epoch"]) - 1
+        self.members = [int(r) for r in
+                        cur.get("old_members", cur["members"])]
+        self.detector = FailureDetector(self.kv, self.rank, self.members,
+                                        timeout=self.detector.timeout)
+        self.straggler.detector = self.detector
+        return self.recover(None)
+
+    # -- protocol internals ----------------------------------------------------
+
+    def _await_proposal(self, dead, joiners, ck):
+        """Wait for (or, as the lowest-ranked survivor, write) the next
+        epoch proposal.  Proposer promotion is implicit: if the lowest
+        survivor dies before proposing, the detector adds it to ``dead``
+        and the next-lowest takes over."""
+        deadline = time.monotonic() + self.reshape_timeout
+        while True:
+            cur = self.kv.get_json("epoch/current")
+            if cur and int(cur.get("epoch", 0)) > self.epoch:
+                return cur
+            dead |= self.detector.poll(force=True) & set(self.members)
+            dead.discard(self.rank)
+            survivors = sorted(set(self.members) - dead)
+            if joiners:
+                joiners = set(self._pending_joiners()) | set(joiners)
+            if self._is_proposer(survivors):
+                proposal = self._make_proposal(dead, joiners,
+                                               survivors, ck)
+                self.kv.put_json("epoch/current", proposal)
+                return proposal
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"rank {self.rank}: no epoch proposal within "
+                    f"{self.reshape_timeout}s (members "
+                    f"{self.members}, dead {sorted(dead)})")
+            time.sleep(0.05)
+
+    def _make_proposal(self, dead, joiners, survivors, ck):
+        new_members = sorted(set(survivors) | set(joiners))
+        # common RAM restore point: the newest step that EVERY survivor
+        # still holds (each advertises its retained steps, not just the
+        # latest — a rank killed mid-snapshot-round leaves the others
+        # one interval ahead, and the retention window is what lets
+        # them meet one step back) and that each dead rank's live buddy
+        # holds that rank's shard at
+        common = None
+        for r in survivors:
+            info = self.kv.get_json(f"snap/{r}")
+            if not info or int(info.get("epoch", -1)) != self.epoch:
+                common = None
+                break
+            steps = set(int(s) for s in
+                        info.get("steps") or [info["step"]])
+            common = steps if common is None else common & steps
+            if not common:
+                break
+        if common:
+            for d in dead:
+                holder = self.buddy_of(d, self.members)
+                held = self.kv.get_json(f"held/{holder}/{d}")
+                if holder in dead or not held \
+                        or int(held.get("epoch", -1)) != self.epoch:
+                    common = None
+                    break
+                common &= set(int(s) for s in held.get("steps", []))
+                if not common:
+                    break
+        ram_step = max(common) if common else None
+        source = "peer" if ram_step is not None else "disk"
+        disk_step = None
+        if source == "disk":
+            disk_step = ck.latest_step() if ck is not None else None
+            if disk_step is None:
+                raise MXNetError(
+                    "elastic recovery: no common RAM snapshot and no "
+                    "committed disk checkpoint to fall back to")
+        for j in joiners:
+            self.kv.delete(f"join_req/{j}")
+        return {"epoch": self.epoch + 1, "members": new_members,
+                "old_members": list(self.members),
+                "dead": sorted(dead), "joined": sorted(joiners),
+                "snap_step": ram_step if source == "peer" else disk_step,
+                "disk_step": disk_step, "source": source,
+                "proposer": self.rank, "t": time.time()}
+
+    def _await_acks(self, epoch, new_members):
+        deadline = time.monotonic() + self.reshape_timeout
+        want = set(new_members)
+        while True:
+            cur = self.kv.get_json("epoch/current") or {}
+            if int(cur.get("epoch", -1)) == epoch:
+                # the record is the source of truth: it may have been
+                # amended below while we waited
+                want = set(int(r) for r in cur.get("members", want))
+                if self.rank not in want:
+                    raise GangEvicted(
+                        f"rank {self.rank}: epoch {epoch} was amended "
+                        f"to exclude this rank; exiting cleanly")
+            acked = set()
+            for key, _ in self.kv.scan(f"epoch_ack/{epoch}"):
+                try:
+                    acked.add(int(key.rsplit("/", 1)[1]))
+                except ValueError:
+                    pass
+            if want <= acked:
+                return
+            # a proposed member that dies BETWEEN the proposal and its
+            # ack would wedge this epoch forever (nobody re-detects it
+            # once everyone is in recover).  The lowest live proposed
+            # member amends the SAME epoch in place, shrinking the
+            # membership to the ranks that can still ack; shard
+            # assembly re-reads the amended record and falls back to
+            # disk if the second death cost it a RAM holder.
+            newly_dead = (want - acked) & self.detector.poll(force=True)
+            newly_dead.discard(self.rank)
+            live = sorted(want - newly_dead)
+            if newly_dead and live and self.rank == min(live) \
+                    and int(cur.get("epoch", -1)) == epoch:
+                cur["members"] = live
+                cur["dead"] = sorted(
+                    set(int(d) for d in cur.get("dead", []))
+                    | newly_dead)
+                cur["joined"] = [j for j in cur.get("joined", [])
+                                 if int(j) not in newly_dead]
+                cur["t"] = time.time()
+                self.kv.put_json("epoch/current", cur)
+                continue
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"epoch {epoch}: missing acks from "
+                    f"{sorted(want - acked)} after "
+                    f"{self.reshape_timeout}s")
+            time.sleep(0.02)
+
+    def _assemble_shards(self, snap_step, old_members, dead):
+        """Every old rank's shard at ``snap_step``, from RAM: own copy,
+        live peers serve their own, dead ranks' come from their buddies.
+        Returns None if any fetch fails (caller degrades to disk)."""
+        shards = {}
+        for o in old_members:
+            try:
+                if o == self.rank:
+                    st = self.peers.own_at(snap_step)
+                elif o in dead:
+                    holder = self.buddy_of(o, old_members)
+                    st = self.peers.fetch(holder, o, snap_step)
+                else:
+                    st = self.peers.fetch(o, o, snap_step)
+            except Exception as e:          # noqa: BLE001
+                sys.stderr.write(
+                    f"[resilience] peer shard fetch for rank {o} at "
+                    f"step {snap_step} failed ({e}); falling back to "
+                    f"disk\n")
+                return None
+            if st is None:
+                return None
+            shards[o] = st
+        return shards
+
+
+def _tel_count(name, n=1):
+    """Guarded telemetry counter (same standalone-load story as
+    `_tel_event`)."""
+    try:
+        from . import telemetry
+    except ImportError:
+        return
+    telemetry.count(name, n)

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""One rank of the multi-process elastic-gang integration tests
+(tests/test_elastic.py).
+
+Runs a deterministic lockstep "training" loop over the gang KV plane:
+every step each member publishes ``(rank+1) * w.sum()`` to
+``red/<epoch>/<step>/<rank>``, meets a gang barrier, and applies the
+same update from the mean contribution — so ``w`` stays bitwise
+replicated across ranks and the printed loss trajectory can be checked
+against a serial numpy simulation of the SAME membership history.
+
+Fault sites (MXTPU_FAULT_INJECT): the worker runs them via
+``ElasticGang.step_tick`` (kill_rank / slow_rank / heartbeat_loss).  A
+respawned rank disarms its own ``kill_rank`` through a marker file in
+the work dir so the second life survives.
+
+Protocol lines on stdout (flushed, parsed by the test):
+
+    PID <rank> <pid>
+    LOSS <rank> <epoch> <step> <loss-as-float-hex>
+    EVICTED <rank>
+    RESULT <json>   (rank, pid, final_step, w0 hex, epoch, members,
+                     source, disk_restores, reshapes)
+
+Usage:  elastic_gang_worker.py <work_dir> <num_steps> [snap_every]
+                               [step_ms]
+Env:    MXTPU_WORKER_RANK, MXTPU_NUM_WORKERS, MXTPU_GANG_DIR (+ the
+        resilience knobs the test sets: heartbeat interval/timeout,
+        MXTPU_KILL_AT_STEP, ...).
+"""
+
+import importlib
+import json
+import os
+import sys
+import time
+import types
+
+
+def _emit(line):
+    """One atomic write per protocol line: ranks share the launcher's
+    stdout pipe, and under PYTHONUNBUFFERED a print()'s text and
+    newline are separate syscalls that interleave across processes."""
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
+def _import_elastic():
+    """Load the resilience/distributed submodules without executing the
+    package __init__ (keeps the gang jax-free and spawn cheap)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "mxnet_tpu" not in sys.modules:
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = pkg
+    res = importlib.import_module("mxnet_tpu.resilience")
+    dist = importlib.import_module("mxnet_tpu.distributed")
+    return res, dist
+
+
+def _allreduce(gang, kv, step, contribution):
+    """Lockstep mean over the gang KV: publish, barrier, read all."""
+    epoch = gang.epoch
+    kv.put_json(f"red/{epoch}/{step}/{gang.rank}",
+                {"v": float(contribution)})
+    gang.barrier(f"red{step}")
+    total = 0.0
+    for r in sorted(gang.members):
+        rec = kv.get_json(f"red/{epoch}/{step}/{r}")
+        total += float(rec["v"])
+    return total / len(gang.members)
+
+
+def _adopt(np, info, rank):
+    """Rebuild local state from a RecoveryInfo: own shard when we have
+    one, any peer's ``w`` (replicated) with a zeroed ``opt`` when we are
+    a fresh joiner, or the full disk state."""
+    if info.shards is not None:
+        st = info.shards.get(rank)
+        if st is None:                  # joiner: no shard of its own
+            st = dict(next(iter(info.shards.values())))
+            st["opt"] = 0.0
+    else:
+        st = info.full_state
+    return {"w": np.array(st["w"], dtype=np.float64),
+            "opt": float(st["opt"])}
+
+
+def main():
+    import numpy as np
+    res, dist = _import_elastic()
+
+    work_dir = sys.argv[1]
+    num_steps = int(sys.argv[2])
+    snap_every = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    step_s = (float(sys.argv[4]) / 1e3) if len(sys.argv) > 4 else 0.0
+    rank = int(os.environ["MXTPU_WORKER_RANK"])
+    world = int(os.environ["MXTPU_NUM_WORKERS"])
+
+    # second-life disarm: the first life of a kill_rank target leaves a
+    # marker; the respawn sees it and drops the fault so it can rejoin
+    marker = os.path.join(work_dir, f"killed_rank{rank}.marker")
+    if rank in res.fault_args("kill_rank"):
+        if os.path.exists(marker):
+            os.environ.pop("MXTPU_FAULT_INJECT", None)
+            os.environ.pop("MXTPU_KILL_AT_STEP", None)
+            res.reset_faults()
+        else:
+            with open(marker, "w") as f:
+                f.write("armed")
+
+    _emit(f"PID {rank} {os.getpid()}")
+
+    kv = dist.FileKV(os.environ["MXTPU_GANG_DIR"])
+    ck = res.LocalCheckpointer(os.path.join(work_dir, f"rank{rank}"))
+    gang = res.ElasticGang(rank, world, kv=kv, checkpointer=ck,
+                           peer_snap_every=snap_every)
+    state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+    step = 0
+    stats = {"reshapes": 0, "disk_restores": 0, "source": None}
+
+    try:
+        info = gang.join()
+        if info is not None:
+            state = _adopt(np, info, rank)
+            step = info.snap_step
+            stats["reshapes"] += 1
+            stats["source"] = info.source
+        while step < num_steps:
+            try:
+                gang.step_tick(step, state=state)
+                if step % snap_every == 0:
+                    ck.save(step, state)
+                w = state["w"]
+                loss = _allreduce(gang, kv, step,
+                                  (rank + 1) * float(w.sum()))
+            except res.RankFailure as rf:
+                info = gang.recover(rf)
+                state = _adopt(np, info, rank)
+                step = info.snap_step
+                stats["reshapes"] += 1
+                stats["source"] = info.source
+                if info.source == "disk":
+                    stats["disk_restores"] += 1
+                continue
+            _emit(f"LOSS {rank} {gang.epoch} {step} {loss.hex()}")
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss / w.size)
+            state["opt"] += loss
+            if step_s:
+                time.sleep(step_s)
+            step += 1
+        gang.stop()
+    except res.GangEvicted:
+        _emit(f"EVICTED {rank}")
+        return 0
+    _emit("RESULT " + json.dumps(
+        {"rank": rank, "pid": os.getpid(), "final_step": step,
+         "w0": float(state["w"][0]).hex(), "epoch": gang.epoch,
+         "members": gang.members, "source": stats["source"],
+         "disk_restores": stats["disk_restores"],
+         "reshapes": stats["reshapes"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,487 @@
+"""Elastic gang recovery (mxnet_tpu/resilience.ElasticGang): the health
+plane (heartbeats, phi failure detector, straggler naming), the
+peer-replicated RAM snapshot store, the epoch-consensus reshape
+protocol, and the end-to-end surviving-a-SIGKILL paths — in-process
+(threads over one FileKV) for tier-1, and real multi-process gangs
+(tests/elastic_gang_worker.py, tools/launch.py --elastic) under
+@slow."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import distributed, resilience, telemetry
+from mxnet_tpu.checkpoint import PeerSnapshotStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "elastic_gang_worker.py")
+_LAUNCH = os.path.join(_REPO, "tools", "launch.py")
+_TRACE_REPORT = os.path.join(_REPO, "tools", "trace_report.py")
+
+
+def _clean_env(**extra):
+    """Subprocess gang env: CPU backend, no inherited faults/telemetry,
+    no stale gang knobs (same recipe as tests/test_telemetry.py)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU",
+                                "MXTPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+# -- the serial reference simulation -------------------------------------------
+
+def _sim_losses(num_steps, phases, n=8):
+    """Replicate elastic_gang_worker.py's arithmetic exactly.
+
+    ``phases`` is [(start_step, members), ...]: the membership in force
+    from that step on.  A reshape rolls the gang back to the common
+    snapshot (= w at the TOP of the boundary step), so a straight serial
+    run that switches membership at the boundary IS "the clean M-rank
+    run from the same snapshot" the acceptance criterion names — the
+    rolled-back executions only produced loss records the re-run
+    overwrote.
+    """
+    w = np.full(n, 1.0, dtype=np.float64)
+    losses = {}
+    for step in range(num_steps):
+        members = None
+        for start, m in sorted(phases):
+            if step >= start:
+                members = m
+        total = 0.0
+        for r in sorted(members):
+            total += float((r + 1) * float(w.sum()))
+        loss = total / len(members)
+        losses[step] = loss
+        w = w * 0.99 - 0.01 * (loss / w.size)
+    return losses, w
+
+
+def _kv_allreduce(gang, kv, step, contribution):
+    """The worker's lockstep KV mean (see elastic_gang_worker.py)."""
+    epoch = gang.epoch
+    kv.put_json(f"red/{epoch}/{step}/{gang.rank}",
+                {"v": float(contribution)})
+    gang.barrier(f"red{step}")
+    total = 0.0
+    for r in sorted(gang.members):
+        total += float(kv.get_json(f"red/{epoch}/{step}/{r}")["v"])
+    return total / len(gang.members)
+
+
+# -- control plane units -------------------------------------------------------
+
+def test_filekv_roundtrip(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    kv.put_json("epoch/current", {"epoch": 3, "members": [0, 2]})
+    assert kv.get_json("epoch/current") == {"epoch": 3,
+                                            "members": [0, 2]}
+    for r in range(3):
+        kv.put_json(f"hb/{r}", {"rank": r, "seq": 1})
+    assert [k for k, _ in kv.scan("hb")] == ["hb/0", "hb/1", "hb/2"]
+    kv.delete("hb/1")
+    kv.delete("hb/1")                       # idempotent
+    assert [k for k, _ in kv.scan("hb")] == ["hb/0", "hb/2"]
+    assert kv.get_json("hb/1", default="gone") == "gone"
+    with pytest.raises(ValueError):
+        kv.put("../escape", b"nope")
+    # float values must survive the JSON hop bitwise (the lockstep
+    # allreduce in the elastic tests depends on it)
+    v = 1.0 / 3.0 * 7.3
+    kv.put_json("red/0/0/0", {"v": v})
+    assert kv.get_json("red/0/0/0")["v"] == v
+
+
+def test_failure_detector_confirms_silence(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    hb = resilience.HeartbeatPublisher(kv, 1, interval=0.02)
+    det = resilience.FailureDetector(kv, 0, [0, 1], timeout=0.3,
+                                     check_interval=0.01)
+    hb.publish_once()
+    assert det.poll(force=True) == set()
+    time.sleep(0.35)                        # silence beyond the timeout
+    assert det.poll(force=True) == {1}
+    hb.publish_once()                       # resurrection: seq moves on
+    assert det.poll(force=True) == set()
+
+
+@pytest.mark.faults
+def test_heartbeat_loss_fault_looks_like_death(fault_inject, tmp_path):
+    """heartbeat_loss:K — wedged-but-alive must be indistinguishable
+    from death: publishes are suppressed, the detector confirms."""
+    kv = distributed.FileKV(str(tmp_path))
+    hb = resilience.HeartbeatPublisher(kv, 1, interval=0.02)
+    det = resilience.FailureDetector(kv, 0, [0, 1], timeout=0.25,
+                                     check_interval=0.01)
+    hb.publish_once()
+    assert det.poll(force=True) == set()
+    seq = kv.get_json("hb/1")["seq"]
+    fault_inject("heartbeat_loss:1")
+    for _ in range(5):
+        hb.publish_once()                   # all suppressed
+    assert kv.get_json("hb/1")["seq"] == seq
+    time.sleep(0.3)
+    assert det.poll(force=True) == {1}
+
+
+def test_straggler_monitor_names_laggard(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    det = resilience.FailureDetector(kv, 0, [0, 1, 2], timeout=60.0,
+                                     check_interval=0.0)
+    kv.put_json("hb/1", {"rank": 1, "seq": 1, "step": 3})
+    kv.put_json("hb/2", {"rank": 2, "seq": 1, "step": 19})
+    det.poll(force=True)
+    mon = resilience.StragglerMonitor(det, window=3,
+                                      share_threshold=0.5)
+    assert mon.observe(20, 0.9) is None     # window not yet full
+    assert mon.observe(21, 0.9) is None
+    assert mon.observe(22, 0.9) == 1        # rank 1 is furthest behind
+    assert mon.observe(23, 0.9) is None     # rate-limited to one/window
+
+
+def test_peer_snapshot_roundtrip(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    s0 = PeerSnapshotStore(0, kv=kv).start()
+    s1 = PeerSnapshotStore(1, kv=kv).start()
+    try:
+        state = {"w": np.arange(4.0), "opt": 3.5}
+        s0.hold_own(4, state, epoch=0)
+        assert s0.own_at(4)["opt"] == 3.5
+        assert s0.send_to(1, 4, state, epoch=0)
+        assert s1.held_steps(0) == [4]
+        got = s0.fetch(1, 0, 4)             # over the socket
+        np.testing.assert_array_equal(got["w"], state["w"])
+        assert got["opt"] == 3.5
+        assert s0.fetch(1, 0, 99) is None   # holder doesn't have it
+        assert kv.get_json("held/1/0")["steps"] == [4]
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_peer_snapshot_retention_and_epoch_filter(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    # retain_s=0: pure count-based pruning
+    s = PeerSnapshotStore(1, kv=kv, keep=2, retain_s=0.0)
+    for step in (2, 4, 6):
+        s._store(0, step, 0, b"x")
+    assert s.held_steps(0) == [4, 6]
+    # a large time floor overrides the count cap: everything inside the
+    # detection window survives (the reshape needs a COMMON step)
+    s2 = PeerSnapshotStore(2, kv=kv, keep=2, retain_s=3600.0)
+    for step in (2, 4, 6, 8):
+        s2._store(0, step, 0, b"x")
+    assert s2.held_steps(0) == [2, 4, 6, 8]
+    # epoch filtering: pre-reshape snapshots are never advertised as
+    # restore points for the reshaped gang
+    s2._store(0, 10, 1, b"x")
+    assert s2.held_steps(0, epoch=1) == [10]
+    assert kv.get_json("held/2/0") == {"steps": [10], "epoch": 1}
+
+
+def test_buddy_ring(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    gang = resilience.ElasticGang(0, 4, kv=kv)
+    assert gang.buddy_of(0) == 1
+    assert gang.buddy_of(3) == 0
+    assert gang.buddy_of(0, [0, 2]) == 2
+    assert gang.buddy_of(2, [0, 2]) == 0
+
+
+def test_join_fresh_gang_writes_epoch_record(tmp_path):
+    """join() on a fresh gang must leave the epoch-0 record behind
+    (it routes through start()), so later joiners have a record to
+    read."""
+    kv = distributed.FileKV(str(tmp_path))
+    gang = resilience.ElasticGang(0, 2, kv=kv,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=1.0)
+    try:
+        assert gang.join() is None
+        cur = kv.get_json("epoch/current")
+        assert cur is not None
+        assert cur["epoch"] == 0 and cur["members"] == [0, 1]
+    finally:
+        gang.stop()
+
+
+# -- in-process gang: reshape, loss parity, report CLI -------------------------
+
+def _run_thread_rank(rank, world, kvdir, num_steps, snap_every, die_at,
+                     out):
+    kv = distributed.FileKV(kvdir)
+    gang = resilience.ElasticGang(rank, world, kv=kv,
+                                  peer_snap_every=snap_every,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=0.5)
+    gang.start()
+    state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+    step, losses, infos = 0, {}, []
+    try:
+        while step < num_steps:
+            if die_at is not None and step == die_at:
+                gang.hb.stop()              # silent death: no heartbeat
+                out[rank] = {"status": "died", "losses": losses,
+                             "gang": gang}
+                return
+            try:
+                gang.step_tick(step, state=state)
+                loss = _kv_allreduce(
+                    gang, kv, step,
+                    (rank + 1) * float(state["w"].sum()))
+            except resilience.RankFailure as rf:
+                info = gang.recover(rf)
+                st = info.shards[rank]
+                state = {"w": np.array(st["w"], dtype=np.float64),
+                         "opt": float(st["opt"])}
+                step = info.snap_step
+                infos.append(info)
+                continue
+            losses[step] = loss
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss /
+                                                     state["w"].size)
+            state["opt"] += loss
+            step += 1
+        out[rank] = {"status": "done", "losses": losses, "gang": gang,
+                     "infos": infos, "w": state["w"]}
+    except Exception as e:                  # noqa: BLE001 — surfaced
+        out[rank] = {"status": "error", "error": repr(e), "gang": gang}
+
+
+def test_thread_gang_survives_silent_death(tmp_path, monkeypatch):
+    """3 ranks over one FileKV; rank 1 goes silent at step 6.  The
+    survivors must reshape to world 2 from the newest COMMON peer
+    snapshot (step 4: the buddy's copy of the dead rank lags one
+    round), and the post-reshape loss trajectory must be bitwise equal
+    to a clean 2-rank run from that snapshot.  The resulting event log
+    must flow through the trace_report CLI."""
+    ev_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
+    telemetry.reset()
+    kvdir = str(tmp_path / "kv")
+    num_steps, snap_every, die_at = 10, 2, 6
+    out = {}
+    threads = [threading.Thread(
+        target=_run_thread_rank,
+        args=(r, 3, kvdir, num_steps, snap_every,
+              die_at if r == 1 else None, out))
+        for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not any(t.is_alive() for t in threads), "gang wedged"
+        assert out[1]["status"] == "died"
+        for r in (0, 2):
+            assert out[r]["status"] == "done", out[r]
+        for r in (0, 2):
+            (info,) = out[r]["infos"]
+            assert info.source == "peer"
+            assert info.snap_step == 4
+            assert info.members == [0, 2]
+            assert info.epoch == 1
+            assert info.dead == [1]
+        # bitwise parity: pre-reshape with [0,1,2], post with [0,2]
+        sim, sim_w = _sim_losses(num_steps, [(0, [0, 1, 2]),
+                                             (4, [0, 2])])
+        for r in (0, 2):
+            assert out[r]["losses"] == sim
+            np.testing.assert_array_equal(out[r]["w"], sim_w)
+        # the dead rank's pre-death losses agree up to the rollback
+        for s in range(4):
+            assert out[1]["losses"][s] == sim[s]
+    finally:
+        for res in out.values():
+            res["gang"].stop()
+        telemetry.reset()                   # close the sink
+
+    # injected-death log through the report CLI
+    proc = subprocess.run(
+        [sys.executable, _TRACE_REPORT, ev_path, "--validate"],
+        env=_clean_env(), capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "resilience:" in proc.stdout
+    assert "dead: rank 1" in proc.stdout
+    assert "reshape: epoch 1 world 2" in proc.stdout
+    assert "from peer" in proc.stdout
+
+
+def test_step_tick_steady_state_overhead(tmp_path):
+    """The health plane must cost ≤1% of a training step: budget the
+    per-tick mechanism (heartbeat note + throttled detector poll +
+    epoch check + periodic RAM snapshot) against a 50 ms step."""
+    kv = distributed.FileKV(str(tmp_path))
+    gang = resilience.ElasticGang(0, 1, kv=kv, peer_snap_every=5,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=5.0)
+    gang.start()
+    try:
+        state = {"w": np.zeros(256, dtype=np.float32)}
+        for step in range(20):              # warm caches
+            gang.step_tick(step, state=state)
+        n = 200
+        t0 = time.perf_counter()
+        for step in range(20, 20 + n):
+            gang.step_tick(step, state=state)
+        per_tick = (time.perf_counter() - t0) / n
+    finally:
+        gang.stop()
+    assert per_tick < 0.01 * 0.050, \
+        f"step_tick costs {per_tick * 1e6:.0f}us — over 1% of a 50ms " \
+        f"step"
+
+
+# -- multi-process gangs (slow) ------------------------------------------------
+
+def _spawn_rank(rank, world, env, args):
+    e = dict(env)
+    e["MXTPU_WORKER_RANK"] = str(rank)
+    e["MXTPU_NUM_WORKERS"] = str(world)
+    return subprocess.Popen([sys.executable, _WORKER] + args, env=e,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _parse_worker_output(text):
+    results, losses, pids = {}, {}, []
+    for ln in text.splitlines():
+        if ln.startswith("RESULT "):
+            rec = json.loads(ln[len("RESULT "):])
+            results[rec["rank"]] = rec
+        elif ln.startswith("LOSS "):
+            _, r, _e, s, h = ln.split()
+            losses[int(s)] = float.fromhex(h)
+        elif ln.startswith("PID "):
+            pids.append(int(ln.split()[2]))
+    return results, losses, pids
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_multiproc_kill_rank_elastic_reshape(tmp_path):
+    """Hermetic 3-rank gang; rank 1 is SIGKILLed at step 9.  Survivors
+    must keep their pids, reshape to world 2 within the heartbeat
+    timeout, restore from buddy RAM (disk restores = 0), and produce a
+    loss trajectory bitwise equal to the clean 2-rank continuation."""
+    world, steps, snap_every, kill_step = 3, 14, 4, 9
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    env = _clean_env(
+        MXTPU_GANG_DIR=str(gang_dir),
+        MXTPU_HEARTBEAT_INTERVAL="0.1",
+        MXTPU_HEARTBEAT_TIMEOUT="1.0",
+        MXTPU_FAULT_INJECT="kill_rank:1",
+        MXTPU_KILL_AT_STEP=str(kill_step),
+    )
+    args = [str(tmp_path), str(steps), str(snap_every)]
+    procs = {r: _spawn_rank(r, world, env, args) for r in range(world)}
+    outs = {r: p.communicate(timeout=120) for r, p in procs.items()}
+    assert procs[1].returncode == -signal.SIGKILL, outs[1]
+    sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2]), (8, [0, 2])])
+    w0 = {}
+    for r in (0, 2):
+        assert procs[r].returncode == 0, outs[r]
+        results, losses, pids = _parse_worker_output(outs[r][0])
+        assert len(pids) == 1, "survivor pid must be stable"
+        rec = results[r]
+        assert rec["pid"] == pids[0]
+        assert rec["final_step"] == steps
+        assert rec["epoch"] == 1
+        assert rec["members"] == [0, 2]
+        assert rec["source"] == "peer"
+        assert rec["disk_restores"] == 0
+        assert rec["reshapes"] == 1
+        assert losses == sim, f"rank {r} loss trajectory diverged"
+        w0[r] = rec["w0"]
+    assert w0[0] == w0[2] == float(sim_w[0]).hex()
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_multiproc_dual_kill_falls_back_to_disk(tmp_path):
+    """Ranks 1 AND 2 die at step 9 — rank 1's buddy (2) is gone too, so
+    no common RAM snapshot can exist and the survivor must complete the
+    run from its disk manifest."""
+    world, steps, snap_every, kill_step = 3, 14, 4, 9
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    env = _clean_env(
+        MXTPU_GANG_DIR=str(gang_dir),
+        MXTPU_HEARTBEAT_INTERVAL="0.1",
+        MXTPU_HEARTBEAT_TIMEOUT="1.0",
+        MXTPU_FAULT_INJECT="kill_rank:1,kill_rank:2",
+        MXTPU_KILL_AT_STEP=str(kill_step),
+    )
+    args = [str(tmp_path), str(steps), str(snap_every)]
+    procs = {r: _spawn_rank(r, world, env, args) for r in range(world)}
+    outs = {r: p.communicate(timeout=120) for r, p in procs.items()}
+    for r in (1, 2):
+        assert procs[r].returncode == -signal.SIGKILL, outs[r]
+    assert procs[0].returncode == 0, outs[0]
+    results, losses, _ = _parse_worker_output(outs[0][0])
+    rec = results[0]
+    assert rec["final_step"] == steps
+    assert rec["members"] == [0]
+    assert rec["source"] == "disk"
+    assert rec["disk_restores"] == 1
+    sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2]), (8, [0])])
+    assert losses == sim
+    assert rec["w0"] == float(sim_w[0]).hex()
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_launch_elastic_respawns_dead_rank_and_rejoins(tmp_path):
+    """tools/launch.py --elastic end to end: rank 1 dies, the gang
+    absorbs it and keeps training; the launcher respawns ONLY rank 1
+    (new pid, ranks 0/2 keep theirs), which disarms its kill via the
+    marker file and rejoins through the join protocol.  Everyone
+    finishes at epoch 2 with world 3 and bitwise-identical state."""
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    steps, snap_every, step_ms = 120, 4, 25
+    env = _clean_env(
+        MXTPU_HEARTBEAT_INTERVAL="0.1",
+        MXTPU_HEARTBEAT_TIMEOUT="1.0",
+        MXTPU_ELASTIC_RESPAWN_DELAY="2.0",
+        MXTPU_FAULT_INJECT="kill_rank:1",
+        MXTPU_KILL_AT_STEP="6",
+    )
+    proc = subprocess.run(
+        [sys.executable, _LAUNCH, "-n", "3", "--elastic",
+         "--gang-dir", str(gang_dir), "--max-restarts", "1", "--",
+         sys.executable, _WORKER, str(tmp_path), str(steps),
+         str(snap_every), str(step_ms)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout[-4000:],
+                                  proc.stderr[-4000:])
+    results, _, _ = _parse_worker_output(proc.stdout)
+    assert sorted(results) == [0, 1, 2], proc.stdout[-4000:]
+    pids_by_rank = {}
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("PID "):
+            _, r, p = ln.split()
+            pids_by_rank.setdefault(int(r), []).append(int(p))
+    assert len(pids_by_rank[0]) == 1      # survivors: stable pids
+    assert len(pids_by_rank[2]) == 1
+    assert len(pids_by_rank[1]) == 2      # victim: respawned once
+    for r in range(3):
+        rec = results[r]
+        assert rec["final_step"] == steps
+        assert rec["epoch"] == 2          # shrink + rejoin
+        assert rec["members"] == [0, 1, 2]
+    assert results[1]["pid"] == pids_by_rank[1][1]
+    assert results[0]["w0"] == results[1]["w0"] == results[2]["w0"]
+    assert "respawning rank 1" in proc.stderr

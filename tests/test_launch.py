@@ -1,0 +1,130 @@
+"""tools/launch.py supervision semantics: exit-code handling (only
+nonzero exits are failures; teardown-induced codes are never reported),
+SIGTERM→SIGKILL grace escalation, full-gang restart, and --elastic
+single-rank respawn."""
+
+import importlib.util
+import os
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "_mxtpu_launch", os.path.join(_REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+launch = _load_launch()
+
+
+def _cmd(script, *args):
+    return [sys.executable, "-c", script] + list(args)
+
+
+def test_supervise_all_clean_exits_zero():
+    procs = launch._spawn_gang(_cmd("import sys; sys.exit(0)"), 2,
+                               port=0)
+    assert launch._supervise_gang(procs, poll_interval=0.05) == 0
+
+
+def test_supervise_reports_first_failure_and_escalates():
+    """The failing rank's code is THE failure; the survivor ignores
+    SIGTERM so teardown must escalate to SIGKILL after the grace — and
+    the survivor's -9 must NOT replace the real code."""
+    sleeper = _cmd("import signal, time\n"
+                   "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                   "time.sleep(60)")
+    failer = _cmd("import time, sys\ntime.sleep(0.7)\nsys.exit(3)")
+    procs = [launch._spawn_worker(sleeper, 0, 2, port=0),
+             launch._spawn_worker(failer, 1, 2, port=0)]
+    t0 = time.monotonic()
+    code = launch._supervise_gang(procs, grace=0.5, poll_interval=0.05)
+    elapsed = time.monotonic() - t0
+    assert code == 3
+    assert procs[0].returncode == -9        # SIGKILL escalation landed
+    assert elapsed < 20, "grace escalation did not bound the teardown"
+
+
+def test_supervise_clean_finish_after_peer_exit_is_not_failure():
+    """A worker that exits 0 after its peer already exited 0 is
+    complete — the gang result is success, not an error."""
+    fast = _cmd("import sys; sys.exit(0)")
+    slow = _cmd("import time, sys\ntime.sleep(0.5)\nsys.exit(0)")
+    procs = [launch._spawn_worker(fast, 0, 2, port=0),
+             launch._spawn_worker(slow, 1, 2, port=0)]
+    assert launch._supervise_gang(procs, poll_interval=0.05) == 0
+
+
+def test_launch_local_restarts_full_gang(tmp_path):
+    """Default mode: one nonzero exit tears the gang down and
+    --max-restarts relaunches everyone; the second attempt (marker
+    files exist) succeeds."""
+    script = ("import os, sys\n"
+              "m = os.path.join(sys.argv[1],"
+              " 'm' + os.environ['MXTPU_WORKER_RANK'])\n"
+              "if os.path.exists(m):\n"
+              "    sys.exit(0)\n"
+              "open(m, 'w').close()\n"
+              "sys.exit(1)\n")
+    rc = launch.main(["-n", "2", "--max-restarts", "1", "--grace", "5",
+                      "--", sys.executable, "-c", script,
+                      str(tmp_path)])
+    assert rc == 0
+    assert sorted(os.listdir(tmp_path)) == ["m0", "m1"]
+
+
+def test_launch_local_exhausted_restarts_returns_failure(tmp_path):
+    rc = launch.main(["-n", "1", "--max-restarts", "1", "--",
+                      sys.executable, "-c", "import sys; sys.exit(9)"])
+    assert rc == 9
+
+
+def test_launch_elastic_respawns_only_dead_rank(tmp_path, monkeypatch):
+    """--elastic: a dying rank is absorbed and respawned individually —
+    the surviving rank's process is never touched — and every worker
+    gets the gang control-plane env."""
+    monkeypatch.setenv("MXTPU_ELASTIC_RESPAWN_DELAY", "0.01")
+    gang_dir = tmp_path / "gang"
+    script = ("import os, sys, time\n"
+              "d = sys.argv[1]\n"
+              "r = os.environ['MXTPU_WORKER_RANK']\n"
+              "assert os.environ.get('MXTPU_ELASTIC') == '1'\n"
+              "assert os.environ.get('MXTPU_GANG_DIR')\n"
+              "open(os.path.join(d, 'pid%s_%d' % (r, os.getpid())),"
+              " 'w').close()\n"
+              "lives = len([f for f in os.listdir(d)"
+              " if f.startswith('pid' + r + '_')])\n"
+              "if r == '1' and lives == 1:\n"
+              "    sys.exit(7)\n"
+              "time.sleep(1.0)\n"
+              "sys.exit(0)\n")
+    rc = launch.main(["-n", "2", "--elastic", "--gang-dir",
+                      str(gang_dir), "--max-restarts", "1", "--",
+                      sys.executable, "-c", script, str(tmp_path)])
+    assert rc == 0
+    pids = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("pid"))
+    assert len([f for f in pids if f.startswith("pid0_")]) == 1
+    assert len([f for f in pids if f.startswith("pid1_")]) == 2
+
+
+def test_launch_elastic_no_survivors_is_failure(tmp_path):
+    rc = launch.main(["-n", "1", "--elastic", "--gang-dir",
+                      str(tmp_path / "gang"), "--",
+                      sys.executable, "-c", "import sys; sys.exit(5)"])
+    assert rc == 5
+
+
+def test_elastic_requires_local_launcher(tmp_path):
+    hosts = tmp_path / "hosts"
+    hosts.write_text("localhost\n")
+    with pytest.raises(SystemExit):
+        launch.main(["-n", "1", "--launcher", "ssh", "--hostfile",
+                     str(hosts), "--elastic", "--", "true"])

@@ -572,3 +572,121 @@ def test_preemption_handler_no_preempt_no_save(tmp_path):
     with PreemptionHandler(ck, lambda: {}, lambda: 0) as h:
         assert not h.maybe_checkpoint()
     assert ck.latest_step() is None
+
+# -- decorrelated jitter (PR 8) ------------------------------------------------
+
+def test_retry_call_decorrelated_jitter_bounds():
+    """jitter=True (default): every sleep lands in [backoff,
+    max_backoff] and depends on the PREVIOUS sleep (uniform up to 3x
+    it), so lockstep retry herds spread out."""
+    sleeps = []
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always, retries=20, backoff=0.001, max_backoff=0.004,
+                   on_retry=lambda a, e, s: sleeps.append(s))
+    assert len(sleeps) == 20
+    for s in sleeps:
+        assert 0.001 <= s <= 0.004
+    # with a cap 4x the floor and 20 draws, identical values would mean
+    # the jitter is not actually sampling
+    assert len(set(sleeps)) > 1
+
+
+def test_retry_call_legacy_proportional_jitter():
+    sleeps = []
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always, retries=3, backoff=0.001, jitter=0.5,
+                   on_retry=lambda a, e, s: sleeps.append(s))
+    # exponential base with at most +50% proportional noise
+    for i, s in enumerate(sleeps):
+        base = 0.001 * (2 ** i)
+        assert base <= s <= base * 1.5 + 1e-9
+
+
+# -- verify-after-write rewrite path (PR 8) ------------------------------------
+
+@pytest.mark.faults
+def test_save_verified_rewrites_once_on_bitrot(fault_inject, tmp_path):
+    """corrupt_ckpt_write:1 bit-rots the first committed file AFTER the
+    rename; _save_verified's readback must catch it and the single
+    rewrite must produce a restorable checkpoint."""
+    fault_inject("corrupt_ckpt_write:1")
+    ck = LocalCheckpointer(tmp_path)
+    resilience._save_verified(ck, 5, {"w": [1.0, 2.0]})
+    assert ck.restore(5) == {"w": [1.0, 2.0]}
+
+
+@pytest.mark.faults
+def test_save_verified_raises_on_persistent_bitrot(fault_inject,
+                                                   tmp_path):
+    """When the rewrite is corrupted too (corrupt_ckpt_write:2), the
+    failure must surface as CheckpointCorrupt — never a silent bad
+    checkpoint."""
+    fault_inject("corrupt_ckpt_write:2")
+    ck = LocalCheckpointer(tmp_path)
+    with pytest.raises(CheckpointCorrupt):
+        resilience._save_verified(ck, 5, {"w": [1.0, 2.0]})
+
+
+# -- recovery decisions as telemetry events (PR 8) -----------------------------
+
+def _read_events(path):
+    import json
+
+    with open(path) as f:
+        return [json.loads(ln) for ln in f.read().splitlines() if ln]
+
+
+def test_resume_latest_emits_ckpt_fallback_event(tmp_path, monkeypatch):
+    from mxnet_tpu import telemetry
+
+    ck = LocalCheckpointer(tmp_path / "ck")
+    ck.save(3, {"x": 1})
+    ck.save(6, {"x": 2})
+    with open(ck._path(6), "r+b") as f:    # bit-rot the newest
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", path)
+    telemetry.reset()
+    got = {}
+    try:
+        assert resilience.resume_latest(ck, got.update) == 3
+    finally:
+        telemetry.reset()                   # close the sink
+    assert got == {"x": 1}
+    events = [r for r in _read_events(path) if r.get("type") == "event"]
+    assert [e["event"] for e in events] == ["ckpt_fallback"]
+    assert events[0]["step"] == 6
+    assert events[0]["reason"] == "CheckpointCorrupt"
+
+
+def test_flush_inflight_emits_dropped_event(tmp_path, monkeypatch):
+    from mxnet_tpu import telemetry
+
+    class FailingAsync:
+        pending_step = 11
+
+        def wait(self):
+            raise OSError("backing store went away")
+
+    path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", path)
+    telemetry.reset()
+    try:
+        resilience.flush_inflight(FailingAsync())   # must not raise
+    finally:
+        telemetry.reset()
+    events = [r for r in _read_events(path) if r.get("type") == "event"]
+    assert [e["event"] for e in events] == ["inflight_save_dropped"]
+    assert events[0]["step"] == 11
+    assert events[0]["reason"] == "OSError"

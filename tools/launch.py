@@ -9,6 +9,18 @@ TPU-native redesign (SURVEY.md §2.6): there is no parameter server; a
 ``jax.distributed.initialize`` rendezvous (coordinator address replaces the
 dmlc tracker).  Supported launchers: ``local`` (N processes on this host —
 the analog of the reference's fake-multi-node nightly tests) and ``ssh``.
+
+Two supervision modes for ``local``:
+
+- default (gang fate-sharing): one nonzero worker exit tears down the
+  whole gang; ``--max-restarts`` relaunches the FULL gang on a fresh
+  port and workers resume from their checkpoints.
+- ``--elastic``: workers share a gang control-plane directory
+  (``MXTPU_GANG_DIR``) and survive peer death in-job
+  (mxnet_tpu/resilience.ElasticGang).  A dead rank does NOT take the
+  gang down; the launcher respawns ONLY that rank (after a delay that
+  lets the survivors agree the shrink epoch first), and the respawn
+  rejoins through the gang's join protocol.
 """
 
 from __future__ import annotations
@@ -17,22 +29,27 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 
-def _spawn_gang(cmd, num_workers, port):
+def _spawn_worker(cmd, rank, num_workers, port, extra_env=None):
+    """Spawn ONE worker with the gang env contract."""
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
+        "MXTPU_NUM_WORKERS": str(num_workers),
+        "MXTPU_WORKER_RANK": str(rank),
+    })
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(cmd, env=env)
+
+
+def _spawn_gang(cmd, num_workers, port, extra_env=None):
     """Spawn one full gang of workers sharing a rendezvous on ``port``."""
-    procs = []
-    coord = f"127.0.0.1:{port}"
-    for rank in range(num_workers):
-        env = dict(os.environ)
-        env.update({
-            "MXTPU_COORDINATOR": coord,
-            "MXTPU_NUM_WORKERS": str(num_workers),
-            "MXTPU_WORKER_RANK": str(rank),
-        })
-        procs.append(subprocess.Popen(cmd, env=env))
-    return procs
+    return [_spawn_worker(cmd, rank, num_workers, port, extra_env)
+            for rank in range(num_workers)]
 
 
 def _terminate_gang(procs, grace=10.0):
@@ -54,6 +71,39 @@ def _terminate_gang(procs, grace=10.0):
                 p.wait()
 
 
+def _supervise_gang(procs, grace=10.0, poll_interval=0.2):
+    """Wait out one gang attempt; returns the attempt's failure code.
+
+    Exit-code semantics, explicitly: ONLY a nonzero exit counts as a
+    failure — a worker that finishes cleanly (exit 0) after its peers
+    die is complete, not failed.  The first nonzero exit triggers gang
+    fate-sharing teardown of the survivors; the codes those survivors
+    then die with (-SIGTERM/-SIGKILL) are artifacts of OUR teardown and
+    are never reported as the failure.  Returns 0 when every worker
+    exited 0.
+    """
+    live = dict(enumerate(procs))      # rank -> proc
+    failed = 0
+    while live:
+        time.sleep(poll_interval)
+        for rank, p in list(live.items()):
+            code = p.poll()
+            if code is None:
+                continue
+            del live[rank]
+            if code != 0 and not failed:
+                failed = code
+                sys.stderr.write(
+                    f"[launch] rank {rank} exited rc={code}\n")
+        if failed and live:
+            # gang fate-sharing: survivors are wedged in collectives
+            # waiting on the dead rank — tear them down now (their
+            # teardown exit codes are not failures, see above)
+            _terminate_gang(list(live.values()), grace=grace)
+            live.clear()
+    return failed
+
+
 def launch_local(args, cmd):
     """Spawn n worker processes on localhost, each with the env
     jax.distributed expects (reference: dmlc tracker 'local' mode env
@@ -69,22 +119,7 @@ def launch_local(args, cmd):
     """
     for attempt in range(args.max_restarts + 1):
         procs = _spawn_gang(cmd, args.num_workers, args.port + attempt)
-        live = {p.pid: p for p in procs}
-        failed = 0
-        while live:
-            time.sleep(0.2)
-            for pid, p in list(live.items()):
-                code = p.poll()
-                if code is None:
-                    continue
-                del live[pid]
-                if code != 0:
-                    failed = code
-            if failed:
-                # gang fate-sharing: survivors are wedged in collectives
-                # waiting on the dead rank — tear them down now
-                _terminate_gang(list(live.values()))
-                live.clear()
+        failed = _supervise_gang(procs, grace=args.grace)
         if not failed:
             return 0
         if attempt < args.max_restarts:
@@ -92,6 +127,66 @@ def launch_local(args, cmd):
                 f"[launch] worker exited rc={failed}; restarting gang "
                 f"(attempt {attempt + 2}/{args.max_restarts + 1}, "
                 f"port {args.port + attempt + 1})\n")
+    return failed
+
+
+def launch_elastic(args, cmd):
+    """Elastic supervision: peer death shrinks the gang instead of
+    killing it; the launcher's job is only to (a) provision the shared
+    control-plane dir and (b) respawn dead ranks so the gang can grow
+    back.
+
+    - ``MXTPU_GANG_DIR`` (created if ``--gang-dir`` is not given) and
+      ``MXTPU_ELASTIC=1`` are exported to every worker.
+    - A rank that exits 0 is COMPLETE (including a rank the gang evicted
+      — GangEvicted exits cleanly); it is never respawned.
+    - A rank that dies (nonzero / signal) while peers are still running
+      is absorbed by the gang; up to ``--max-restarts`` such ranks are
+      respawned — same rank id, same port — after
+      ``MXTPU_ELASTIC_RESPAWN_DELAY`` seconds (default 1.5x the
+      heartbeat timeout) so the survivors commit the shrink epoch before
+      the rejoin request lands.
+    - The launcher fails (returns the exit code) only when a rank dies
+      with NO surviving peers to absorb it, or a death exceeds the
+      respawn budget and the remaining gang also fails.
+    """
+    gang_dir = args.gang_dir or tempfile.mkdtemp(prefix="mxtpu_gang_")
+    extra = {"MXTPU_GANG_DIR": gang_dir, "MXTPU_ELASTIC": "1"}
+    hb_timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", 5.0))
+    delay = float(os.environ.get("MXTPU_ELASTIC_RESPAWN_DELAY",
+                                 1.5 * hb_timeout))
+    sys.stderr.write(f"[launch] elastic gang dir: {gang_dir}\n")
+    procs = {rank: _spawn_worker(cmd, rank, args.num_workers, args.port,
+                                 extra)
+             for rank in range(args.num_workers)}
+    respawns = 0
+    failed = 0
+    while procs:
+        time.sleep(0.2)
+        for rank, p in list(procs.items()):
+            code = p.poll()
+            if code is None:
+                continue
+            del procs[rank]
+            if code == 0:
+                continue                      # complete, not failed
+            if not procs:
+                # nobody left to absorb the death: a real job failure
+                sys.stderr.write(f"[launch] rank {rank} exited "
+                                 f"rc={code} with no survivors\n")
+                failed = failed or code
+                continue
+            sys.stderr.write(f"[launch] rank {rank} died rc={code}; "
+                             f"gang absorbs it "
+                             f"({len(procs)} survivors)\n")
+            if respawns < args.max_restarts:
+                respawns += 1
+                time.sleep(delay)             # let the shrink commit
+                sys.stderr.write(
+                    f"[launch] respawning rank {rank} "
+                    f"(respawn {respawns}/{args.max_restarts})\n")
+                procs[rank] = _spawn_worker(
+                    cmd, rank, args.num_workers, args.port, extra)
     return failed
 
 
@@ -114,7 +209,7 @@ def launch_ssh(args, cmd):
     return code
 
 
-def main():
+def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Launch a distributed mxnet_tpu job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
@@ -123,20 +218,35 @@ def main():
     parser.add_argument("--hostfile", default=None)
     parser.add_argument("--port", type=int, default=9927)
     parser.add_argument("--max-restarts", type=int, default=0,
-                        help="relaunch the full gang up to N times after "
-                             "a nonzero worker exit (local launcher); "
-                             "workers resume from their checkpoints")
+                        help="default mode: relaunch the full gang up to "
+                             "N times after a nonzero worker exit; "
+                             "--elastic: respawn up to N dead ranks")
+    parser.add_argument("--grace", type=float, default=10.0,
+                        help="seconds between SIGTERM and SIGKILL when "
+                             "tearing down a failed gang")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic supervision (local launcher): a "
+                             "dead rank is absorbed by the surviving "
+                             "gang and respawned individually instead "
+                             "of restarting everyone")
+    parser.add_argument("--gang-dir", default=None,
+                        help="shared control-plane dir for --elastic "
+                             "(default: a fresh temp dir)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     if not cmd:
         parser.error("no command given")
-    if args.launcher == "local":
-        sys.exit(launch_local(args, cmd))
-    sys.exit(launch_ssh(args, cmd))
+    if args.launcher != "local":
+        if args.elastic:
+            parser.error("--elastic requires the local launcher")
+        return launch_ssh(args, cmd)
+    if args.elastic:
+        return launch_elastic(args, cmd)
+    return launch_local(args, cmd)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -5,7 +5,11 @@ Reads a ``train_events.jsonl`` and prints, per run id: the step-time
 breakdown table (mean microseconds + share of the step interval), MFU
 statistics, and a summary of the discrete resilience events — skipped
 steps (with step ids), restarts, divergence rollbacks, watchdog
-expiries, checkpoint commits.
+expiries, checkpoint commits.  Elastic gang events (rank_dead /
+mesh_reshape / rank_rejoin / elastic_recover, see
+mxnet_tpu/resilience.py) get their own narrative section: who died at
+which step, what each new mesh epoch looks like, and how long each
+recovery took and from which source (peer RAM vs disk).
 
 Stdlib-only on purpose: it must run on a machine with neither jax nor
 the package installed (pull the JSONL off a pod, read it anywhere).
@@ -102,6 +106,58 @@ def report_run(run, records, out):
             ids = [e["step"] for e in group if "step" in e]
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
+        report_resilience(kinds, out)
+
+
+def report_resilience(kinds, out):
+    """Narrative summary of the elastic-gang events in one run.
+
+    ``kinds`` is the {event kind: [records]} map built by report_run.
+    Prints nothing when the run had no elastic activity.
+    """
+    elastic_kinds = ("rank_suspected", "straggler_suspected", "rank_dead",
+                     "rank_rejoin", "mesh_reshape", "elastic_recover",
+                     "ckpt_fallback", "inflight_save_dropped")
+    if not any(k in kinds for k in elastic_kinds):
+        return
+    out.write("  resilience:\n")
+    for e in kinds.get("rank_suspected", ()):
+        out.write(f"    suspected: rank {e.get('rank', '?')} silent "
+                  f"{_fmt(e.get('silence_s'), 2)} s "
+                  f"(phi {_fmt(e.get('phi'), 1)})\n")
+    for e in kinds.get("straggler_suspected", ()):
+        out.write(f"    straggler: rank {e.get('rank', '?')} at step "
+                  f"{e.get('step', '?')} (mean collective share "
+                  f"{_fmt(e.get('mean_collective_share'), 3)})\n")
+    for e in kinds.get("rank_dead", ()):
+        out.write(f"    dead: rank {e.get('rank', '?')} "
+                  f"(epoch {e.get('epoch', '?')}, "
+                  f"detected at step {e.get('step', '?')})\n")
+    for e in kinds.get("rank_rejoin", ()):
+        out.write(f"    rejoin: rank {e.get('rank', '?')} "
+                  f"(epoch {e.get('epoch', '?')})\n")
+    for e in kinds.get("mesh_reshape", ()):
+        out.write(f"    reshape: epoch {e.get('epoch', '?')} world "
+                  f"{e.get('world', '?')} members "
+                  f"{e.get('members', '?')} at step "
+                  f"{e.get('step', '?')}\n")
+    recovers = kinds.get("elastic_recover", ())
+    for e in recovers:
+        out.write(f"    recover: epoch {e.get('epoch', '?')} from "
+                  f"{e.get('source', '?')} at step {e.get('step', '?')} "
+                  f"in {_fmt(e.get('recovery_ms'))} ms\n")
+    lat = [e.get("recovery_ms") for e in recovers
+           if e.get("recovery_ms") is not None]
+    if lat:
+        out.write(f"    recovery latency: mean "
+                  f"{sum(lat) / len(lat):.1f} ms  max {max(lat):.1f} ms "
+                  f"over {len(lat)} recover(ies)\n")
+    for e in kinds.get("ckpt_fallback", ()):
+        out.write(f"    ckpt fallback: step {e.get('step', '?')} "
+                  f"unreadable ({e.get('reason', '?')})\n")
+    for e in kinds.get("inflight_save_dropped", ()):
+        out.write(f"    inflight save dropped: step "
+                  f"{e.get('step', '?')} ({e.get('reason', '?')})\n")
 
 
 def validate_all(records):
