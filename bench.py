@@ -186,6 +186,22 @@ def _trainer_attempts(tpu_ok):
     return attempts
 
 
+def _sharded_attempts(tpu_ok):
+    steps = int(os.environ.get("BENCH_SHARDED_STEPS", 10))
+    cfg = {"model": "sharded_step", "batch": 8, "steps": steps}
+    attempts = []
+    if tpu_ok:
+        attempts.append((None, dict(cfg, backend="tpu"), 300))
+    # forced-host 8-device mesh: the SAME sharded program shapes (TP
+    # collectives, FSDP gathers) compile and run on any box; the
+    # orchestrator tags the numbers sharded_on_chip_unavailable
+    attempts.append((
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        dict(cfg, backend="cpu"), 300))
+    return attempts
+
+
 def _pipeline_attempts():
     # pure host work (decode/augment/collate) + device_put: always runs
     # on CPU so it never touches the tunnel and never needs a TPU probe
@@ -492,6 +508,13 @@ def orchestrate():
             ckpt = _run_worker(env_over, cfg, budget, ckpt_errors)
             if ckpt is not None:
                 break
+    sharded = None
+    sharded_errors = []
+    if headline is not None and not os.environ.get("BENCH_SKIP_SHARDED"):
+        for env_over, cfg, budget in _sharded_attempts(tpu_ok):
+            sharded = _run_worker(env_over, cfg, budget, sharded_errors)
+            if sharded is not None:
+                break
     recovery = None
     recovery_errors = []
     if headline is not None \
@@ -597,6 +620,31 @@ def orchestrate():
         headline["ckpt_state_mb"] = ckpt.get("state_mb")
     elif ckpt_errors:
         headline["ckpt_error"] = "; ".join(ckpt_errors)[-300:]
+    if sharded is not None:
+        headline["tp_step_us"] = sharded["value"]
+        headline["fsdp_step_us"] = sharded.get("fsdp_step_us")
+        headline["tp_device_peak_bytes"] = \
+            sharded.get("tp_device_peak_bytes")
+        headline["fsdp_device_peak_bytes"] = \
+            sharded.get("fsdp_device_peak_bytes")
+        headline["tp_collective_bytes_by_axis"] = \
+            sharded.get("tp_collective_bytes_by_axis")
+        headline["fsdp_collective_bytes_by_axis"] = \
+            sharded.get("fsdp_collective_bytes_by_axis")
+        headline["tp_mesh"] = sharded.get("tp_mesh")
+        headline["fsdp_mesh"] = sharded.get("fsdp_mesh")
+        # same discipline as the headline: a forced-host mesh number
+        # may only survive tagged, never as an on-chip result
+        if sharded.get("backend") == "cpu":
+            headline["sharded_on_chip_unavailable"] = {
+                "reason": probe_note if not tpu_ok
+                else "tpu attempts failed; cpu fallback produced the "
+                     "sharded numbers",
+                "fallback_backend": "cpu",
+                "numbers_are_cpu": True,
+            }
+    elif sharded_errors:
+        headline["sharded_error"] = "; ".join(sharded_errors)[-300:]
     if recovery:
         headline.update(recovery)
     if recovery_errors:
@@ -855,6 +903,8 @@ def worker(cfg):
         bench_input_pipeline(cfg, devices)
     elif cfg["model"] == "ckpt":
         bench_ckpt(cfg, devices)
+    elif cfg["model"] == "sharded_step":
+        bench_sharded(cfg, devices)
     else:
         bench_resnet(cfg, devices)
 
@@ -1289,6 +1339,104 @@ def bench_trainer(cfg, devices):
         and guard_overhead_pct < 5.0,
         "params": actual,
         "batch": n_params,
+        "backend": devices[0].platform,
+    }))
+
+
+def bench_sharded(cfg, devices):
+    """tp_step_us / fsdp_step_us: full sharded train-step latency on a
+    small transformer with the model-parallel collectives fused into
+    the ONE donated jit program (parallel/sharding.py shard_model +
+    gluon/captured.py), two modes on the same mesh abstraction:
+
+    - tp: Megatron-style tensor parallelism over the ``tp`` axis
+      (column/row weight splits + activation constraints);
+    - fsdp: params sharded over the data axis, gathered per-layer
+      inside the program.
+
+    Per mode, also reported: per-device memory high-water
+    (compiled.memory_analysis) and per-axis collective bytes the HLO
+    actually issues (telemetry.collective_bytes_by_axis) — both read
+    from the telemetry StepStats records the timed loop emits, the
+    same always-on accounting the trainer bench uses."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel, telemetry
+    from mxnet_tpu.gluon import captured
+    from mxnet_tpu.gluon.model_zoo.bert import TransformerEncoder
+
+    steps = cfg["steps"]
+    n = max(1, len(devices))
+    tp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    dp = n // tp
+    units, hidden, layers, batch, t = 64, 256, 2, cfg["batch"], 6
+
+    rng = np.random.RandomState(0)
+    x_np = rng.normal(size=(batch, t, units)).astype(np.float32)
+    y_np = rng.randint(0, units, size=(batch, t)).astype(np.float32)
+
+    def _run_mode(mode, mesh_axes):
+        mesh = parallel.make_mesh(**mesh_axes)
+        mx.random.seed(7)
+        net = TransformerEncoder(num_layers=layers, units=units,
+                                 num_heads=4, hidden_size=hidden,
+                                 dropout=0.0)
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        parallel.shard_model(net, mesh, mode=mode)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        loss_fn.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+
+        def step():
+            return tr.train_step(net, loss_fn, mx.nd.array(x_np),
+                                 mx.nd.array(y_np))
+
+        _readback(step())
+        _readback(step())
+        captured.reset_counters()
+        telemetry.reset()
+        dt, _ = _timed_loop(step, steps, per_step_readback=True)
+        recs = [r for r in telemetry.recent_steps()
+                if r.get("path") == "captured"][-steps:]
+        peak = coll = None
+        for r in reversed(recs):
+            if peak is None and r.get("device_peak_bytes") is not None:
+                peak = r["device_peak_bytes"]
+            if coll is None and r.get("collective_bytes_by_axis"):
+                coll = r["collective_bytes_by_axis"]
+        out = {
+            "step_us": round(dt / steps * 1e6, 1),
+            "device_peak_bytes": peak,
+            "collective_bytes_by_axis": coll,
+            "dispatches": captured.dispatch_count(),
+            "mesh": dict(mesh_axes),
+        }
+        parallel.set_default_mesh(None)
+        return out
+
+    tp_out = _run_mode("tp", {"dp": dp, "tp": tp})
+    fsdp_out = _run_mode("fsdp", {"dp": n})
+
+    print(json.dumps({
+        "metric": "tp_step_us",
+        "value": tp_out["step_us"],
+        "unit": "us/step",
+        "vs_baseline": None,
+        "fsdp_step_us": fsdp_out["step_us"],
+        "tp_device_peak_bytes": tp_out["device_peak_bytes"],
+        "fsdp_device_peak_bytes": fsdp_out["device_peak_bytes"],
+        "tp_collective_bytes_by_axis": tp_out["collective_bytes_by_axis"],
+        "fsdp_collective_bytes_by_axis":
+            fsdp_out["collective_bytes_by_axis"],
+        "tp_mesh": tp_out["mesh"],
+        "fsdp_mesh": fsdp_out["mesh"],
+        "tp_dispatches": tp_out["dispatches"],
+        "fsdp_dispatches": fsdp_out["dispatches"],
+        "steps": steps,
+        "batch": batch,
         "backend": devices[0].platform,
     }))
 

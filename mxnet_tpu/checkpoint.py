@@ -1072,8 +1072,110 @@ def make_checkpointer(directory, max_to_keep=3, async_save=None,
     return ck
 
 
+def _gluon_walk_state(s, fn):
+    if isinstance(s, (list, tuple)):
+        out = [_gluon_walk_state(v, fn) for v in s]
+        return out if isinstance(s, list) else tuple(out)
+    return fn(s)
+
+
+def _gluon_trainer_state(trainer):
+    """`trainer_state` for the imperative gluon Trainer: parameters in
+    trainer order, optimizer states keyed by trainer index, and the
+    optimizer's update counters — everything `load_trainer_state` needs
+    to resume the captured/eager step bitwise."""
+    upd = trainer._updaters[0]
+    o = trainer._optimizer
+    idxs = sorted(upd.states)
+    return snapshot_to_host({
+        "params": [p.data() for p in trainer._params],
+        "opt_state": [upd.states[i] for i in idxs],
+        "opt_index": [int(i) for i in idxs],
+        "num_update": int(o.num_update),
+        "update_counts": {str(k): int(v)
+                          for k, v in o._index_update_count.items()},
+    })
+
+
+def _gluon_trainer_template(trainer):
+    """`trainer_state_template` for the gluon Trainer: the CURRENT
+    parameter placements (`parallel.shard_model`'s NamedShardings)
+    become the restore targets; weight-shaped optimizer state re-lays
+    with its weight, everything else restores unplaced (host numpy,
+    re-placed by `load_trainer_state`)."""
+    from jax.sharding import NamedSharding
+
+    upd = trainer._updaters[0]
+    idxs = sorted(upd.states)
+
+    def sh_of(p):
+        s = getattr(p.data()._data, "sharding", None)
+        return s if isinstance(s, NamedSharding) else None
+
+    def state_tmpl(st, sh, wshape):
+        def leaf(v):
+            if hasattr(v, "__array__") and \
+                    tuple(getattr(v, "shape", ())) == wshape:
+                return sh
+            return None
+        return _gluon_walk_state(st, leaf)
+
+    shs = [sh_of(p) for p in trainer._params]
+    return {
+        "params": shs,
+        "opt_state": [state_tmpl(upd.states[i], shs[i],
+                                 tuple(trainer._params[i].shape))
+                      for i in idxs],
+        "opt_index": None,
+        "num_update": None,
+        "update_counts": None,
+    }
+
+
+def _gluon_load_trainer_state(trainer, state):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from .ndarray import _from_jax
+
+    upd = trainer._updaters[0]
+    o = trainer._optimizer
+    for p, v in zip(trainer._params, state["params"]):
+        nd = p.data()
+        sh = getattr(nd._data, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            nd._set_data(jax.device_put(v, sh))
+        else:
+            nd._set_data(jnp.asarray(v))
+    for i, st in zip(state["opt_index"], state["opt_state"]):
+        p = trainer._params[i]
+        sh = getattr(p.data()._data, "sharding", None)
+        wshape = tuple(p.shape)
+
+        def leaf(v, _sh=sh, _ws=wshape):
+            if not hasattr(v, "__array__"):
+                return v
+            if isinstance(_sh, NamedSharding) and \
+                    tuple(getattr(v, "shape", ())) == _ws:
+                return _from_jax(jax.device_put(v, _sh))
+            return _from_jax(jnp.asarray(v))
+
+        upd.states[int(i)] = _gluon_walk_state(st, leaf)
+        upd.states_synced[int(i)] = True
+    o.num_update = int(state["num_update"])
+    o._index_update_count = {int(k): int(v) for k, v
+                             in state["update_counts"].items()}
+    return trainer
+
+
 def trainer_state(trainer):
-    """Extract a ShardedTrainer's full state as a SNAPSHOT pytree.
+    """Extract a trainer's full state as a SNAPSHOT pytree.
+
+    Accepts a `parallel.ShardedTrainer` or an imperative
+    `gluon.Trainer` (duck-typed on ``_param_vals``) — the captured-step
+    path checkpoints through the same template machinery as the
+    compiled one.
 
     Every leaf is a host copy (`snapshot_to_host`), never a live
     reference into the trainer: the trainer's buffers are donated to the
@@ -1082,6 +1184,8 @@ def trainer_state(trainer):
     serialize garbage.  Restoring this snapshot is bitwise-identical no
     matter how far the trainer trained on after the call.
     """
+    if not hasattr(trainer, "_param_vals"):
+        return _gluon_trainer_state(trainer)
     return snapshot_to_host({
         "params": list(trainer._param_vals),
         "opt_state": [list(s) for s in trainer._opt_state],
@@ -1094,9 +1198,12 @@ def trainer_state_template(trainer):
     """The elastic-restore ``template`` matching `trainer_state`'s
     structure: array positions hold this trainer's `NamedSharding`s, so
     a checkpoint written under any world size/mesh re-lays onto THIS
-    trainer's mesh (`AsyncCheckpointer.restore(step, template=...)`)."""
+    trainer's mesh (`AsyncCheckpointer.restore(step, template=...)`).
+    Duck-typed like `trainer_state`."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    if not hasattr(trainer, "_param_vals"):
+        return _gluon_trainer_template(trainer)
     repl = NamedSharding(trainer.mesh, PartitionSpec())
     return {
         "params": list(trainer._param_shardings),
@@ -1108,9 +1215,12 @@ def trainer_state_template(trainer):
 
 
 def load_trainer_state(trainer, state):
-    """Load a restored pytree back into a ShardedTrainer."""
+    """Load a restored pytree back into a trainer (duck-typed like
+    `trainer_state`)."""
     import jax
 
+    if not hasattr(trainer, "_param_vals"):
+        return _gluon_load_trainer_state(trainer, state)
     trainer._param_vals = [
         jax.device_put(v, s) for v, s in
         zip(state["params"], trainer._param_shardings)]

@@ -306,6 +306,12 @@ class HybridBlock(Block):
     """A Block compilable into one XLA program (reference: gluon.HybridBlock
     + src/imperative/cached_op.cc; see module docstring for the design)."""
 
+    # activation sharding annotation (parallel/sharding.py): a
+    # (spec_tuple, mesh) pair applied to this block's forward output via
+    # with_sharding_constraint — class attr so pre-existing instances
+    # and __setattr__-before-__init__ paths read None cheaply
+    _act_spec = None
+
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._active = False
@@ -410,6 +416,44 @@ class HybridBlock(Block):
         nd_save(f"{path}-{epoch:04d}.params", arg_dict)
         return sym
 
+    # -- activation sharding ---------------------------------------------------
+
+    def shard_activations(self, spec, mesh=None):
+        """Pin this block's forward output to a PartitionSpec (Megatron
+        activation annotation, e.g. ``('dp', None, 'tp')`` after a
+        column-parallel projection).  ``mesh=None`` resolves the process
+        default mesh at call time.  Takes effect inside every jit that
+        traces this block — CachedOp forward and the captured train
+        step — and is a no-op when no mesh (or a trivial one) is
+        active, so annotated models still run unsharded."""
+        self._act_spec = (tuple(spec), mesh)
+        self._clear_cached_op()
+        return self
+
+    def _constrain_out(self, out):
+        if self._act_spec is None:
+            return out
+        from ..parallel.mesh import default_mesh
+        from ..parallel.sharding import constrain
+
+        spec, mesh = self._act_spec
+        if mesh is None:
+            mesh = default_mesh()
+        if mesh is None:
+            return out
+
+        def one(v):
+            if isinstance(v, NDArray):
+                v._set_data(constrain(v._data, mesh, spec))
+                return v
+            if hasattr(v, "ndim"):
+                return constrain(v, mesh, spec)
+            return v
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(one(v) for v in out)
+        return one(out)
+
     # -- forward dispatch ------------------------------------------------------
 
     def forward(self, x, *args):
@@ -449,7 +493,8 @@ class HybridBlock(Block):
                 params[k] = p.data()._data
         from .. import ndarray as F
 
-        return self.hybrid_forward(F, x, *args, **params)
+        return self._constrain_out(
+            self.hybrid_forward(F, x, *args, **params))
 
     def _eager_forward(self, x, *args):
         from .. import ndarray as F
@@ -461,7 +506,8 @@ class HybridBlock(Block):
             for p in self._reg_params.values():
                 p._finish_deferred_init()
             params = {k: p.data() for k, p in self._reg_params.items()}
-        return self.hybrid_forward(F, x, *args, **params)
+        return self._constrain_out(
+            self.hybrid_forward(F, x, *args, **params))
 
     def _deferred_infer_shape(self, x, *args):
         self.infer_shape(x, *args)

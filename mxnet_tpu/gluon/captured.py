@@ -186,6 +186,30 @@ def ineligible_reason(trainer, block, loss_fn, data, grad_accum):
     return None
 
 
+def _mesh_sharding_of(trainer):
+    """(mesh, fingerprint) of the trainer's parameter placements, or
+    (None, None) when params are single-device.  The fingerprint —
+    mesh axis sizes + every param's PartitionSpec string — joins the
+    capture cache key: re-sharding a model (shard_model, a mesh
+    reshape after gang recovery) MUST miss the cache, because the
+    donated program's layouts were inferred from the old placements."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharding import mesh_of_params
+
+    params = list(trainer._params)
+    mesh = mesh_of_params(params)
+    if mesh is None:
+        return None, None
+    fp = []
+    for i, p in enumerate(params):
+        raw = getattr(getattr(p, "_data", None), "_data", None)
+        sh = getattr(raw, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            fp.append((i, str(sh.spec)))
+    return mesh, (tuple(sorted(mesh.shape.items())), tuple(fp))
+
+
 def _tree_version(block):
     """DFS tuple of ``_cache_version`` over a block tree: any
     `_clear_cached_op` anywhere in the tree (parameter set, child
@@ -269,6 +293,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     plan_sig = tuple(
         (kernel, static_items, dt, tuple(i for i, *_r in items))
         for (kernel, static_items, dt), items in groups.items())
+    mesh, mesh_fp = _mesh_sharding_of(trainer)
     key = (
         id(block), _tree_version(block),
         id(loss_fn), _tree_version(loss_fn),
@@ -278,7 +303,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
         tuple(data.shape), str(_raw(data).dtype),
         None if label is None else (tuple(label.shape),
                                     str(_raw(label).dtype)),
-        _kvs.device_fingerprint(),
+        _kvs.device_fingerprint(), mesh_fp,
     )
     cache = getattr(trainer, "_captured_cache", None)
     if cache is None:
@@ -292,7 +317,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     step = CapturedStep(trainer, block, loss_fn, trained, groups,
                         guard_on=guard_on, clip=clip,
                         has_scaler=has_scaler, grad_accum=k,
-                        has_label=label is not None)
+                        has_label=label is not None, mesh=mesh)
     while len(cache) >= _MAX_CACHE:
         cache.pop(next(iter(cache)))
     cache[key] = step
@@ -312,7 +337,14 @@ class CapturedStep:
     """
 
     def __init__(self, trainer, block, loss_fn, trained, groups,
-                 guard_on, clip, has_scaler, grad_accum, has_label):
+                 guard_on, clip, has_scaler, grad_accum, has_label,
+                 mesh=None):
+        # mesh the parameters are committed over (None = single-device):
+        # batch inputs are placed over its dp axis, and the program's
+        # param/state outputs are pinned to the input shardings so the
+        # donated buffers round-trip without a layout change (a drifting
+        # output sharding would retrace NEXT step's jit)
+        self._mesh = mesh
         self._block = block
         self._loss_fn = loss_fn
         self._trained = trained          # [(trainer_index, Parameter)]
@@ -337,6 +369,9 @@ class CapturedStep:
         # capture signature — never on the per-step path
         self._arg_specs = None
         self._flops = _SENTINEL_UNSET
+        self._compiled = _SENTINEL_UNSET
+        self._collective_bytes = _SENTINEL_UNSET
+        self._peak_bytes = _SENTINEL_UNSET
         self._fn = self._build()
 
     # -- trace ------------------------------------------------------------------
@@ -358,6 +393,20 @@ class CapturedStep:
             self._want_guard, self._guard_on, self._clip
         has_scaler, has_label = self._has_scaler, self._has_label
         loss_keyed = self._loss_keyed
+        mesh = self._mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(mesh, PartitionSpec())
+
+            def _sh(p):
+                s = p.data()._data.sharding
+                return s if isinstance(s, NamedSharding) else repl
+
+            train_shs = [_sh(p) for _i, p in self._trained]
+            other_shs = [_sh(p) for _n, p in self._others]
+        else:
+            train_shs = other_shs = None
         train_ids = [id(p) for _i, p in self._trained]
         train_dtypes = [p.data()._data.dtype for _i, p in self._trained]
         other_ids = [id(p) for _n, p in self._others]
@@ -451,7 +500,23 @@ class CapturedStep:
                     nw, ns = gfn(ws, gsl, states, dyn)
                 for p, w in zip(pos, nw):
                     new_train[p] = w
+                if train_shs is not None:
+                    # states shard with their weight (grouped kernels
+                    # only ever see weight-shaped state)
+                    ns = [[jax.lax.with_sharding_constraint(
+                               a, train_shs[p]) for a in item_states]
+                          for p, item_states in zip(pos, ns)]
                 new_states.append(ns)
+            if train_shs is not None:
+                # pin param/aux outputs to their INPUT shardings: the
+                # donated buffers must round-trip layout-stable or the
+                # next dispatch sees new input shardings and retraces
+                # (sits at the program tail, outside every cut/cond —
+                # no fusion decision changes upstream of it)
+                new_train = [jax.lax.with_sharding_constraint(v, s)
+                             for v, s in zip(new_train, train_shs)]
+                new_others = [jax.lax.with_sharding_constraint(v, s)
+                              for v, s in zip(new_others, other_shs)]
             return new_train, new_others, new_states, losses, health
 
         return jax.jit(pure_step, donate_argnums=(0, 1, 2))
@@ -504,6 +569,21 @@ class CapturedStep:
                 if label is not None:
                     yr = _raw(label)
                     ys = yr.reshape((k, yr.shape[0] // k) + yr.shape[1:])
+            if self._mesh is not None:
+                # split the (micro)batch dim over dp: committed batch
+                # placement, so GSPMD infers the data-parallel layout
+                # instead of replicating the batch (leading=1 under
+                # grad-accum — dim 0 is the scan axis)
+                import jax
+
+                from ..parallel.sharding import batch_sharding
+
+                lead = 0 if k == 1 else 1
+                xs = jax.device_put(xs, batch_sharding(
+                    self._mesh, xs.shape[lead], leading=lead))
+                if ys is not None:
+                    ys = jax.device_put(ys, batch_sharding(
+                        self._mesh, ys.shape[lead], leading=lead))
         scaler = getattr(trainer, "_amp_loss_scaler", None)
         scale = _np.float32(scaler.loss_scale if scaler else 1.0)
         train_raws = [p.data()._data for _i, p in self._trained]
@@ -536,27 +616,71 @@ class CapturedStep:
             trainer._finalize_guarded_step(guard, snapshot)
         return _from_jax(losses)
 
-    # -- MFU accounting (mxnet_tpu/telemetry.py) --------------------------------
+    # -- program accounting (mxnet_tpu/telemetry.py) ----------------------------
+
+    def _compiled_for_stats(self):
+        """The compiled step program re-lowered against the recorded
+        arg avals — at most once per capture signature, with no device
+        dispatch and no readback.  The retrace this lowering performs is
+        excluded from `trace_count` (that counter pins RUNTIME
+        retraces).  None when avals are unknown or lowering fails."""
+        global _TRACE_COUNT
+        if self._compiled is _SENTINEL_UNSET:
+            self._compiled = None
+            if self._arg_specs is not None:
+                saved = _TRACE_COUNT
+                try:
+                    self._compiled = \
+                        self._fn.lower(*self._arg_specs).compile()
+                except Exception:
+                    self._compiled = None
+                finally:
+                    _TRACE_COUNT = saved
+        return self._compiled
 
     def cost_flops(self):
         """Total FLOPs of the compiled step program via XLA cost
-        analysis, or None when unavailable.  Computed at most once per
-        capture signature by re-lowering against the recorded arg avals
-        (no device dispatch, no readback); the retrace this lowering
-        performs is excluded from `trace_count` — that counter pins
-        RUNTIME retraces."""
-        global _TRACE_COUNT
+        analysis, or None when unavailable."""
         if self._flops is _SENTINEL_UNSET:
-            self._flops = None
-            if self._arg_specs is not None:
+            from .. import telemetry
+
+            compiled = self._compiled_for_stats()
+            self._flops = None if compiled is None \
+                else telemetry.flops_of_compiled(compiled)
+        return self._flops
+
+    def memory_high_water(self):
+        """Per-device memory high-water of the step program in bytes
+        (arguments + outputs + XLA temp allocations, donation aliases
+        counted once), or None when the compiler doesn't expose it."""
+        if self._peak_bytes is _SENTINEL_UNSET:
+            self._peak_bytes = None
+            compiled = self._compiled_for_stats()
+            if compiled is not None:
+                try:
+                    ma = compiled.memory_analysis()
+                    total = (int(ma.temp_size_in_bytes)
+                             + int(ma.argument_size_in_bytes)
+                             + int(ma.output_size_in_bytes)
+                             - int(getattr(ma, "alias_size_in_bytes",
+                                           0)))
+                    self._peak_bytes = max(total, 0)
+                except Exception:
+                    self._peak_bytes = None
+        return self._peak_bytes
+
+    def collective_bytes_by_axis(self):
+        """{axis: bytes-moved-per-device} over the step program's
+        collectives (telemetry.collective_bytes_by_axis), or None on a
+        single-device capture / when HLO is unavailable."""
+        if self._collective_bytes is _SENTINEL_UNSET:
+            self._collective_bytes = None
+            if self._mesh is not None:
                 from .. import telemetry
 
-                saved = _TRACE_COUNT
-                try:
-                    compiled = self._fn.lower(*self._arg_specs).compile()
-                    self._flops = telemetry.flops_of_compiled(compiled)
-                except Exception:
-                    self._flops = None
-                finally:
-                    _TRACE_COUNT = saved
-        return self._flops
+                compiled = self._compiled_for_stats()
+                if compiled is not None:
+                    self._collective_bytes = \
+                        telemetry.collective_bytes_by_axis(
+                            compiled, self._mesh)
+        return self._collective_bytes

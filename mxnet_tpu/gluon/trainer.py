@@ -186,6 +186,7 @@ class Trainer:
         if batch_size is None:
             batch_size = data.shape[0]
         k = int(grad_accum)
+        self._maybe_shard_batch(data, label)
         acc = telemetry.step_begin()
         n_skipped = len(self.skipped_steps)
         step = None
@@ -205,6 +206,12 @@ class Trainer:
                 result = step(self, data, label, batch_size)
                 if acc is not None:
                     telemetry.note(flops=step.cost_flops())
+                    peak = step.memory_high_water()
+                    if peak is not None:
+                        telemetry.note(device_peak_bytes=peak)
+                    coll = step.collective_bytes_by_axis()
+                    if coll:
+                        telemetry.note(collective_bytes_by_axis=coll)
             else:
                 result = self._eager_train_step(
                     block, loss_fn, data, label, batch_size, k,
@@ -215,6 +222,26 @@ class Trainer:
         telemetry.step_end(acc, step=self._step_count,
                            skipped=len(self.skipped_steps) > n_skipped)
         return result
+
+    def _maybe_shard_batch(self, data, label):
+        """When the parameters are committed over a multi-device mesh
+        (`parallel.shard_model`), place the batch over its dp axis
+        IN-PLACE, before the captured/eager branch — both paths must
+        see the identical committed placement or the eager oracle's
+        programs would lay data out differently and break bitwise
+        parity with the captured program."""
+        from ..ndarray import NDArray
+        from ..parallel.sharding import batch_sharding, mesh_of_params
+
+        mesh = mesh_of_params(self._params)
+        if mesh is None:
+            return
+        import jax
+
+        for nd in (data, label):
+            if isinstance(nd, NDArray) and nd.ndim >= 1:
+                sh = batch_sharding(mesh, nd.shape[0])
+                nd._set_data(jax.device_put(nd._data, sh))
 
     def _eager_train_step(self, block, loss_fn, data, label, batch_size,
                           grad_accum, ignore_stale_grad):
@@ -288,6 +315,18 @@ class Trainer:
             return None
         keys = [i for i, param in enumerate(self._params)
                 if param._grad_req != "null"]
+        from ..parallel.sharding import mesh_of_params
+
+        if mesh_of_params(self._params) is not None:
+            # GSPMD owns the collectives when params live on a mesh:
+            # the bucketed host-side pushpull would flat-concat the
+            # grads, silently all-gathering every shard — per-key
+            # pushpull keeps each reduce shard-shaped
+            for i in keys:
+                self._kvstore.pushpull(i, self._params[i].list_grad(),
+                                       out=self._params[i].list_grad(),
+                                       priority=-i)
+            return None
         if opt.grouped.fused_step_enabled() \
                 and hasattr(self._kvstore, "bucketed_pushpull"):
             grads = [self._params[i].list_grad() for i in keys]

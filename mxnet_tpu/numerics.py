@@ -128,7 +128,13 @@ def _health_fn():
 def grad_health(raws):
     """ONE jit dispatch over the step's raw gradient arrays → a ``(2,)``
     f32 device array ``[all_finite, global_sq_norm]``.  Nothing is read
-    back to the host here; jit caches per (shapes, dtypes) structure."""
+    back to the host here; jit caches per (shapes, dtypes) structure.
+
+    Sharding-aware by construction: jit keys on the inputs' committed
+    shardings, so mesh-sharded gradients (parallel/sharding.py
+    shard_model) get their own specialization in which GSPMD reduces
+    each shard locally and psums the ``(2,)`` partials — the guard
+    never gathers a full gradient."""
     return _health_fn()(list(raws))
 
 

@@ -364,6 +364,32 @@ def _raw(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _place_state_like(state, weight):
+    """Lay freshly-created optimizer state over the owning weight's
+    NamedSharding (parallel/sharding.py shard_model): same-shaped
+    moments shard with the weight so grouped updates run shard-local —
+    no gather, no replicated state copy.  Shared by both update paths
+    because state creation is shared; a replicated / single-device
+    weight leaves the state untouched."""
+    from jax.sharding import NamedSharding
+
+    raw_w = _raw(weight)
+    sh = getattr(raw_w, "sharding", None)
+    if not isinstance(sh, NamedSharding) or sh.mesh.size <= 1:
+        return state
+
+    import jax
+
+    def place(s):
+        if isinstance(s, (list, tuple)):
+            return type(s)(place(v) for v in s)
+        if isinstance(s, NDArray) and s.shape == raw_w.shape:
+            s._set_data(jax.device_put(s._data, sh))
+        return s
+
+    return place(state)
+
+
 def plan_items(updater, index, grad, weight):
     """Partition ``(index, grad, weight)`` triples into fused groups,
     creating optimizer states on demand through the SAME
@@ -386,6 +412,7 @@ def plan_items(updater, index, grad, weight):
         if i not in upd.states:
             upd.states[i] = o.create_state_multi_precision(i, w)
             upd.states_synced[i] = True
+            _place_state_like(upd.states[i], w)
         item = None
         if plan is not None and _groupable(o, w, g):
             item = plan(o, i, w, upd.states[i])

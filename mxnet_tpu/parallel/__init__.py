@@ -17,8 +17,11 @@ from . import mesh
 from .mesh import (DP, EP, PP, SP, TP, data_parallel_mesh, default_mesh,
                    make_mesh, set_default_mesh)
 from . import sharding
-from .sharding import (MOE_EP_RULES, ShardingRules, TRANSFORMER_TP_RULES,
-                       annotate_block, combined_rules)
+from .sharding import (FSDPRules, MOE_EP_RULES, ShardingRules,
+                       TRANSFORMER_TP_RULES, annotate_activations,
+                       annotate_block, batch_sharding, combined_rules,
+                       fsdp_rules, match_partition_rules, mesh_of_params,
+                       param_sharding, shard_model)
 from . import ring
 from .ring import ring_attention, ulysses_attention
 from . import pipeline

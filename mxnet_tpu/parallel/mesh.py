@@ -16,8 +16,6 @@ from __future__ import annotations
 
 import numpy as _np
 
-from ..base import MXNetError
-
 DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
 
 
@@ -32,9 +30,14 @@ def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
     import jax
     from jax.sharding import Mesh
 
+    override = devices is not None
     if devices is None:
         devices = jax.devices()
     sizes = {"pp": pp, "dp": dp, "sp": sp, "ep": ep, "tp": tp}
+    for name, size in sizes.items():
+        if not isinstance(size, int) or size < 1:
+            raise ValueError(
+                f"make_mesh: axis {name}={size!r} must be a positive int")
     axes = [(name, size) for name, size in sizes.items() if size > 1]
     if not axes:
         axes = [("dp", 1)]
@@ -42,9 +45,14 @@ def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
     for _, s in sizes.items():
         total *= s
     if total > len(devices):
-        raise MXNetError(
-            f"mesh {sizes} needs {total} devices but only "
-            f"{len(devices)} available")
+        # clear, early ValueError naming the axis product and the device
+        # count — not whatever jax raises downstream from a bad reshape
+        product = " * ".join(f"{n}={s}" for n, s in sizes.items()
+                             if s > 1) or "dp=1"
+        source = "devices= override" if override else "jax.devices()"
+        raise ValueError(
+            f"make_mesh: axis product {product} = {total} devices, but "
+            f"only {len(devices)} available from {source}")
     names = [n for n, _ in axes]
     shape = [s for _, s in axes]
     arr = _np.asarray(devices[:total]).reshape(shape)

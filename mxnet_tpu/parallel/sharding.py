@@ -1,4 +1,4 @@
-"""Parameter sharding rules (tensor parallelism).
+"""Parameter sharding rules (tensor parallelism + FSDP).
 
 NEW, TPU-first (SURVEY.md §2.5: TP is absent in the reference).  A rule set
 maps parameter-name regexes to ``PartitionSpec``s; ``pjit``/GSPMD inserts
@@ -12,33 +12,122 @@ reference layout, fully_connected.cc):
 - row-parallel (shard INPUT dim, spec (None, 'tp')): attention output
   projection, FFN down-projection — its products need one psum, which GSPMD
   emits where the annotations meet.
+
+FSDP is the second mode on the same surface: `FSDPRules` is a shape-driven
+rule set that shards every large-enough parameter over the DATA axis —
+GSPMD then all-gathers each layer's weights inside the step program and
+reduce-scatters its gradients, overlapped with the backward pass.
+
+Resolution order (pinned by tests/test_parallel.py): FIRST MATCH WINS, in
+insertion order — there is no most-specific-pattern scoring.  Put narrow
+patterns before broad ones; `combined_rules(a, b)` makes every rule of
+``a`` outrank every rule of ``b``.
 """
 
 from __future__ import annotations
 
+import os
 import re
 
-from .mesh import TP
+from .mesh import DP, TP
 
 
 class ShardingRules:
-    """Ordered (regex → PartitionSpec tuple) rules; first match wins."""
+    """Ordered (regex → PartitionSpec tuple) rules; first match wins.
+
+    ``spec_for(name, shape=None)`` resolves a parameter name to a
+    `PartitionSpec`.  The base class ignores ``shape`` (shape-aware
+    subclasses like `FSDPRules` consume it); a ``shape=None`` call is
+    always legal and resolves regex rules only.  When nothing matches,
+    the ``default`` spec applies — ``()`` (fully replicated) unless the
+    rule set was built with another default.
+    """
 
     def __init__(self, rules=(), default=()):
         self._rules = [(re.compile(p), spec) for p, spec in rules]
         self._default = tuple(default)
 
-    def spec_for(self, name, shape=None):
+    def _match(self, name, shape=None):
+        """The first matching spec, or None (→ caller's default)."""
         from jax.sharding import PartitionSpec
 
         for pat, spec in self._rules:
             if pat.search(name):
                 return PartitionSpec(*spec)
-        return PartitionSpec(*self._default)
+        return None
+
+    def spec_for(self, name, shape=None):
+        from jax.sharding import PartitionSpec
+
+        spec = self._match(name, shape)
+        return spec if spec is not None \
+            else PartitionSpec(*self._default)
 
     def add(self, pattern, spec):
         self._rules.append((re.compile(pattern), tuple(spec)))
         return self
+
+
+def fsdp_min_size():
+    """MXTPU_FSDP_MIN_SIZE: parameters with fewer elements stay
+    replicated under FSDP (biases, layernorm scales — sharding them
+    buys nothing and costs a collective each)."""
+    try:
+        return int(os.environ.get("MXTPU_FSDP_MIN_SIZE", "1024"))
+    except ValueError:
+        return 1024
+
+
+class FSDPRules(ShardingRules):
+    """Shape-driven FSDP: shard each parameter over the data axis.
+
+    Explicit regex ``rules`` outrank the shape heuristic (so TP rules
+    can sit in front via ``combined_rules(TRANSFORMER_TP_RULES,
+    fsdp_rules(mesh))`` for tp-within-fsdp layouts).  The heuristic
+    shards the FIRST dimension the axis size divides; parameters with
+    fewer than ``min_size`` elements (default `fsdp_min_size()`), with
+    no divisible dimension, or with unknown shape stay replicated.
+    """
+
+    def __init__(self, axis=DP, axis_size=None, min_size=None,
+                 rules=(), default=()):
+        super().__init__(rules=rules, default=default)
+        self.axis = axis
+        self.axis_size = axis_size
+        self.min_size = fsdp_min_size() if min_size is None \
+            else int(min_size)
+
+    def _match(self, name, shape=None):
+        from jax.sharding import PartitionSpec
+
+        spec = super()._match(name, shape)
+        if spec is not None:
+            return spec
+        if not shape:
+            return None
+        n = 1
+        for d in shape:
+            n *= int(d)
+        if n < self.min_size:
+            return None
+        for dim, d in enumerate(shape):
+            if self.axis_size is None or \
+                    (self.axis_size > 0 and d % self.axis_size == 0):
+                entries = [None] * len(shape)
+                entries[dim] = self.axis
+                return PartitionSpec(*entries)
+        return None
+
+
+def fsdp_rules(mesh=None, axis=DP, axis_size=None, min_size=None,
+               rules=()):
+    """`FSDPRules` bound to ``mesh``'s data-axis size (divisibility is
+    checked against it); with no mesh, pass ``axis_size`` directly or
+    leave both None to shard dim 0 unconditionally."""
+    if axis_size is None and mesh is not None:
+        axis_size = mesh.shape.get(axis, 1)
+    return FSDPRules(axis=axis, axis_size=axis_size, min_size=min_size,
+                     rules=rules)
 
 
 # default rule set for the transformer family (gluon/model_zoo/bert.py
@@ -72,14 +161,48 @@ MOE_EP_RULES = ShardingRules(rules=[
 ], default=())
 
 
+class _CombinedRules(ShardingRules):
+    """First match wins ACROSS rule sets, shape heuristics included."""
+
+    def __init__(self, sets):
+        super().__init__()
+        self._sets = list(sets)
+
+    def _match(self, name, shape=None):
+        for rs in self._sets:
+            spec = rs._match(name, shape)
+            if spec is not None:
+                return spec
+        return None
+
+    def add(self, pattern, spec):
+        # appended rules have the LOWEST precedence, matching the
+        # concatenation semantics
+        self._sets.append(ShardingRules(rules=[(pattern, spec)]))
+        return self
+
+
 def combined_rules(*rule_sets):
     """Merge rule sets (first match wins across the concatenation) —
     e.g. combined_rules(TRANSFORMER_TP_RULES, MOE_EP_RULES) for a
-    tp×ep transformer."""
-    merged = ShardingRules()
-    for rs in rule_sets:
-        merged._rules.extend(rs._rules)
-    return merged
+    tp×ep transformer, or combined_rules(TRANSFORMER_TP_RULES,
+    fsdp_rules(mesh)) for TP weights with an FSDP fallback.  Every
+    rule (and shape heuristic) of an earlier set overrides every rule
+    of a later set on conflicting names."""
+    return _CombinedRules(rule_sets)
+
+
+def match_partition_rules(rules, params):
+    """Bulk resolution: ``{name: PartitionSpec}`` for every entry of
+    ``params`` (a dict of name → Parameter / array / shape tuple) —
+    the pytree-of-specs step between a rule set and `NamedSharding`
+    placement."""
+    specs = {}
+    for name, p in params.items():
+        shape = p if isinstance(p, (tuple, list)) \
+            else getattr(p, "shape", None)
+        specs[name] = rules.spec_for(name, shape)
+    return specs
 
 
 def annotate_block(block, rules):
@@ -106,3 +229,156 @@ def param_sharding(param, mesh):
         else:
             cleaned.append(None)
     return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+# -- imperative-path placement (gluon Trainer + CapturedStep) ------------------
+
+def shard_model(block, mesh, mode="tp", rules=None, axis=DP,
+                min_size=None, trainer=None):
+    """Annotate AND place a gluon block's parameters over ``mesh`` —
+    the imperative twin of ShardedTrainer's staging, consumed by
+    `gluon.Trainer.train_step`'s captured program (gluon/captured.py).
+
+    Two modes on one rule surface:
+
+    - ``mode='tp'``: Megatron tensor parallelism from ``rules``
+      (default `TRANSFORMER_TP_RULES`) — Dense/attention weights split
+      over the ``tp`` axis; pair with
+      `HybridBlock.shard_activations` / `annotate_activations` for the
+      activation constraints.
+    - ``mode='fsdp'``: every large-enough parameter sharded over the
+      data axis (`fsdp_rules`); GSPMD gathers each layer's weights
+      inside the step program and reduce-scatters its gradients.
+      ``rules`` (if given) overrides the shape heuristic per name.
+
+    Initialized parameters (and their gradient buffers) are
+    `jax.device_put` onto their `NamedSharding` immediately, making
+    them committed sharded arrays every later jit (CachedOp forward,
+    captured step, eager grouped update) infers its layout from.
+    Aux parameters (``grad_req='null'`` — BatchNorm stats) replicate.
+    Also sets the process default mesh.  Returns ``{name: spec}``.
+
+    When RE-sharding a model that already trained (an elastic gang
+    reshape, or turning sharding on mid-run), pass the gluon
+    ``trainer``: its existing optimizer states are committed to the
+    OLD placement and must move with their weights, or the next step's
+    jit sees incompatible device sets.  Fresh states (created on the
+    first post-shard step) place themselves.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from .mesh import set_default_mesh
+
+    if mode == "fsdp":
+        base = fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)
+        rules = base if rules is None else combined_rules(rules, base)
+    elif mode == "tp":
+        rules = TRANSFORMER_TP_RULES if rules is None else rules
+    else:
+        raise ValueError(f"shard_model: unknown mode {mode!r} "
+                         "(expected 'tp' or 'fsdp')")
+    from ..gluon.parameter import DeferredInitializationError
+
+    specs = {}
+    for name, p in block.collect_params().items():
+        if p.grad_req == "null":
+            # aux state (BN running stats) replicates in both modes
+            p.partition_spec = PartitionSpec()
+        else:
+            p.partition_spec = rules.spec_for(name, p.shape)
+        specs[name] = p.partition_spec
+        sh = param_sharding(p, mesh)
+        try:
+            nd = p.data()
+        except DeferredInitializationError:
+            continue  # spec stamps now, placement at materialization
+        nd._set_data(jax.device_put(nd._data, sh))
+        g = getattr(p, "_grad", None)
+        if g is not None and getattr(g, "_data", None) is not None \
+                and getattr(p, "_grad_stype", None) != "row_sparse":
+            g._set_data(jax.device_put(g._data, sh))
+    if trainer is not None:
+        from ..optimizer.grouped import _place_state_like
+
+        params = list(trainer._params)
+        for upd in getattr(trainer, "_updaters", []):
+            for i, st in upd.states.items():
+                if st is not None and 0 <= i < len(params):
+                    _place_state_like(st, params[i].data())
+    set_default_mesh(mesh)
+    return specs
+
+
+def mesh_of_params(params):
+    """The Mesh an (iterable of) gluon Parameters is laid over, or None:
+    the first committed multi-device `NamedSharding` found wins.  Cheap
+    attribute walking only — safe on the per-step path."""
+    from jax.sharding import NamedSharding
+
+    for p in params:
+        raw = getattr(getattr(p, "_data", None), "_data", None)
+        sh = getattr(raw, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.size > 1:
+            return sh.mesh
+    return None
+
+
+def batch_sharding(mesh, dim_size=None, leading=0, axis=DP):
+    """NamedSharding splitting the batch dimension (dim ``leading``)
+    over the data axis — replicated when the mesh has no dp axis or
+    ``dim_size`` is not divisible by it (uneven batches stay whole
+    rather than tripping a GSPMD padding path the eager oracle would
+    not take)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    size = mesh.shape.get(axis, 1)
+    if size <= 1 or (dim_size is not None and dim_size % size != 0):
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh,
+                         PartitionSpec(*([None] * leading + [axis])))
+
+
+def constrain(x, mesh, spec):
+    """`with_sharding_constraint` with the same leniency as
+    `param_sharding`: axes absent from the mesh drop to None, and a
+    spec longer than ``x``'s rank is a no-op (identity) instead of an
+    error — so one activation annotation runs sharded and unsharded."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return x
+    entries = [e if e is None
+               or (e in mesh.shape and mesh.shape[e] > 1) else None
+               for e in tuple(spec)]
+    ndim = getattr(x, "ndim", None)
+    if ndim is None or len(entries) > ndim:
+        return x
+    # divisibility guard per sharded dim: constraint on a non-divisible
+    # dim forces GSPMD padding the eager oracle never sees
+    for dim, e in enumerate(entries):
+        if e is not None and x.shape[dim] % mesh.shape[e] != 0:
+            entries[dim] = None
+    sh = NamedSharding(mesh, PartitionSpec(*entries))
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)
+
+
+def annotate_activations(block, rules, mesh=None):
+    """Walk the block tree; any HybridBlock whose NAME matches a rule
+    pattern gets `shard_activations(spec, mesh)` — the rules-driven way
+    to place Megatron activation constraints without touching model
+    code (block names, not parameter names, are matched here)."""
+    def walk(b):
+        if hasattr(b, "shard_activations"):
+            for pat, spec in getattr(rules, "_rules", []):
+                if pat.search(getattr(b, "name", "") or ""):
+                    b.shard_activations(spec, mesh)
+                    break
+        for child in getattr(b, "_children", {}).values():
+            walk(child)
+
+    walk(block)
+    return block

@@ -26,7 +26,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _from_jax
 from ..ops import optimizer_op as _op
 from .mesh import DP, data_parallel_mesh
-from .sharding import ShardingRules, annotate_block, param_sharding
+from .sharding import (ShardingRules, annotate_block, fsdp_rules,
+                       param_sharding)
 
 
 class _PureOptimizer:
@@ -186,7 +187,7 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd",
                  optimizer_params=None, mesh=None, rules=None,
-                 batch_axis=DP, grad_accum=1, remat=None):
+                 batch_axis=DP, grad_accum=1, remat=None, mode=None):
         import jax
 
         from .. import engine
@@ -199,6 +200,14 @@ class ShardedTrainer:
         opt_kwargs = dict(optimizer_params or {})
         lr = opt_kwargs.pop("learning_rate", opt_kwargs.pop("lr", 0.01))
         self.optimizer = _PureOptimizer(optimizer, lr=lr, **opt_kwargs)
+        if mode == "fsdp" and rules is None:
+            # FSDP over the batch axis: rules resolve per-shape, so
+            # annotation is deferred to _stage (after deferred init)
+            rules = fsdp_rules(mesh=self.mesh, axis=batch_axis)
+        elif mode not in (None, "tp", "fsdp"):
+            raise MXNetError(f"ShardedTrainer: unknown mode {mode!r} "
+                             "(expected 'tp' or 'fsdp')")
+        self._rules = rules
         if rules is not None:
             annotate_block(block, rules)
         self._grad_accum = int(grad_accum)
@@ -227,6 +236,10 @@ class ShardedTrainer:
                     self.block(example)
             finally:
                 _TRACE.force_eager = prev
+        if self._rules is not None:
+            # re-resolve with materialized shapes: shape-driven rules
+            # (FSDPRules) see None for deferred params at __init__ time
+            annotate_block(self.block, self._rules)
         allp = list(self.block.collect_params().items())
         self._trainable = [(n, p) for n, p in allp if p.grad_req != "null"]
         self._aux = [(n, p) for n, p in allp if p.grad_req == "null"]

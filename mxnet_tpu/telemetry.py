@@ -520,6 +520,127 @@ def flops_of_compiled(compiled):
         return None
 
 
+_COLLECTIVE_RE = None
+
+
+def collective_bytes_by_axis(compiled, mesh):
+    """Per-device bytes moved by the step program's collectives,
+    attributed to mesh axes: ``{"dp": ..., "tp": ..., "all": ...}``.
+
+    Parses the compiled HLO text for `all-reduce` / `all-gather` /
+    `reduce-scatter` / `all-to-all` / `collective-permute` ops, reads
+    each op's replica groups, and attributes the op to the mesh axis
+    whose size matches the group size (group stride breaking ties:
+    contiguous groups are inner axes, strided groups outer; tp is
+    innermost by `make_mesh`'s canonical order).  Bytes use the ring
+    cost model per participating device: ``2(S-1)/S·bytes`` for
+    all-reduce, ``(S-1)/S·bytes`` for all-gather / reduce-scatter /
+    all-to-all, ``1·bytes`` for collective-permute.  Returns {} when
+    the HLO is unavailable or parses to nothing — callers treat that
+    as "no data", never as "zero collectives".
+    """
+    global _COLLECTIVE_RE
+    import re as _re
+
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = _re.compile(
+            r"=\s*(?P<shape>.+?)\s+"
+            r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+            r"collective-permute)(?:-start)?\(")
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        return {}
+    if not hlo:
+        return {}
+
+    dtype_bytes = {
+        "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+        "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+        "s32": 4, "u32": 4, "f32": 4,
+        "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    }
+    shape_re = _re.compile(r"(\w+)\[([\d,]*)\]")
+
+    def bytes_of(shape_txt):
+        total = 0
+        for dt, dims in shape_re.findall(shape_txt):
+            nb = dtype_bytes.get(dt)
+            if nb is None:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * nb
+        return total
+
+    # axis sizes and strides in the mesh's device array: innermost axis
+    # has stride 1, so a CONTIGUOUS replica group ({0,1},{2,3},...) of
+    # size S belongs to the innermost axis of that size
+    names = list(mesh.axis_names)
+    sizes = [mesh.shape[n] for n in names]
+    strides = {}
+    acc = 1
+    for n, s in zip(reversed(names), reversed(sizes)):
+        strides[n] = acc
+        acc *= s
+
+    def axis_of(group_size, contiguous):
+        if group_size >= mesh.size:
+            return "all"
+        cands = [n for n in names if mesh.shape[n] == group_size]
+        if not cands:
+            return "other"
+        if len(cands) == 1:
+            return cands[0]
+        # tie: contiguous groups ⇒ smallest stride (innermost axis)
+        key = (lambda n: strides[n]) if contiguous \
+            else (lambda n: -strides[n])
+        return sorted(cands, key=key)[0]
+
+    out = {}
+    for line in hlo.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or "-done" in line[:m.start()]:
+            continue
+        op = m.group("op")
+        shape_txt = m.group("shape")
+        group_size, contiguous = mesh.size, True
+        gm = _re.search(r"replica_groups=\{(\{[\d,]+\})", line)
+        if gm is not None:
+            first = [int(x) for x in
+                     gm.group(1).strip("{}").split(",") if x]
+            group_size = max(len(first), 1)
+            contiguous = all(b - a == 1
+                             for a, b in zip(first, first[1:]))
+        else:
+            gm = _re.search(
+                r"replica_groups=\[(\d+),(\d+)\]<=\[[\d,]+\](T\()?",
+                line)
+            if gm is not None:
+                group_size = max(int(gm.group(2)), 1)
+                contiguous = gm.group(3) is None
+        s = group_size
+        nbytes = bytes_of(shape_txt)
+        if op == "all-reduce":
+            moved = 2.0 * (s - 1) / s * nbytes
+        elif op == "collective-permute":
+            moved = float(nbytes)
+        else:
+            # all-gather bytes from the RESULT shape, reduce-scatter
+            # from the operand — the printed shape is the result either
+            # way; for reduce-scatter the operand is S× the result, so
+            # (S-1)/S·operand == (S-1)·result
+            if op == "reduce-scatter":
+                moved = float(s - 1) * nbytes
+            else:
+                moved = (s - 1) / s * nbytes
+        axis = axis_of(s, contiguous)
+        out[axis] = out.get(axis, 0) + int(moved)
+    return out
+
+
 # -- schema validation (tests + tools/trace_report.py --validate) --------------
 
 def validate_record(rec):
@@ -578,4 +699,18 @@ def validate_record(rec):
     if rec.get("cache_hit") is not None and \
             not isinstance(rec["cache_hit"], bool):
         fail("cache_hit must be a bool or null")
+    # optional sharded-step fields (PR 9): absent on unsharded runs
+    cba = rec.get("collective_bytes_by_axis")
+    if cba is not None:
+        if not isinstance(cba, dict):
+            fail("collective_bytes_by_axis must be an object or absent")
+        for k, val in cba.items():
+            if not isinstance(k, str) or \
+                    not isinstance(val, int) or val < 0:
+                fail("collective_bytes_by_axis entries must be "
+                     "str → non-negative int")
+    peak = rec.get("device_peak_bytes")
+    if peak is not None and \
+            (not isinstance(peak, (int, float)) or peak < 0):
+        fail("device_peak_bytes must be a non-negative number or absent")
     return rec

@@ -67,6 +67,29 @@ def fault_inject(monkeypatch):
     resilience.reset_faults()
 
 
+@pytest.fixture
+def mesh8():
+    """Factory for multi-device meshes on the virtual 8-device CPU
+    platform (the XLA_FLAGS forcing at the top of this file): tier-1
+    TP/FSDP sharding tests run on every CI pass, not only on real
+    hardware.  Skips when the platform somehow exposes < 8 devices
+    (e.g. XLA_FLAGS was pinned by the environment before pytest
+    started).  Tears down the process default mesh so a test's
+    `shard_model` can't leak placements into the next test."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+
+    def make(**axes):
+        return parallel.make_mesh(**axes)
+
+    yield make
+    parallel.set_default_mesh(None)
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     """Reference: @with_seed() in tests/python/unittest/common.py —

@@ -362,6 +362,113 @@ def test_elastic_trainer_restore_bitwise(tmp_path):
     tr.step(x, y)   # restored trainer still trains
 
 
+def test_elastic_restore_dp4_onto_dp2_tp2_bitwise(tmp_path):
+    """PR 9 satellite: a checkpoint written under a pure ``dp=4`` mesh
+    restores BITWISE onto a ``dp=2,tp=2`` mesh with Megatron TP rules,
+    through `AsyncCheckpointer`'s template path — the PR 5 elastic
+    mechanism aimed at the new shardings."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    def build(prefix):
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(4, in_units=16))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    # writer: dp=4 over half the devices
+    mx.random.seed(3)
+    src = parallel.ShardedTrainer(
+        build("ckel_"), gluon.loss.L2Loss(), "adam",
+        {"learning_rate": 1e-2},
+        mesh=parallel.make_mesh(dp=4, devices=jax.devices()[:4]))
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 4).astype(np.float32)
+    src.step(x, y)
+    src.step(x, y)
+    st = checkpoint.trainer_state(src)
+    frozen = [np.array(p, copy=True) for p in st["params"]]
+    _save_two_rank(tmp_path, 5, st)
+
+    # reader: dp=2,tp=2 with TP rules over dense weights
+    mx.random.seed(99)  # different init — restore must overwrite it
+    rules = parallel.ShardingRules(rules=[
+        (r"dense0_weight$", ("tp", None)),
+        (r"dense1_weight$", (None, "tp")),
+    ])
+    dst = parallel.ShardedTrainer(
+        build("ckel2_"), gluon.loss.L2Loss(), "adam",
+        {"learning_rate": 1e-2},
+        mesh=parallel.make_mesh(dp=2, tp=2), rules=rules)
+    dst.step(x, y)  # stage + one step of divergent training
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    restored = ck.restore(5, template=dst.state_template())
+    checkpoint.load_trainer_state(dst, restored)
+    tp_specs = [sh.spec for sh in dst._param_shardings]
+    assert PartitionSpec("tp", None) in tp_specs  # template was TP
+    for got, want, sh in zip(dst._param_vals, frozen,
+                             dst._param_shardings):
+        assert got.sharding.is_equivalent_to(sh, got.ndim)
+        assert np.array_equal(np.asarray(got), want)  # bitwise
+    assert dst._num_update == int(st["num_update"])
+    dst.step(x, y)  # restored trainer still trains on the new mesh
+
+
+def test_gluon_trainer_checkpoint_roundtrip_sharded(tmp_path):
+    """The imperative gluon Trainer checkpoints through the SAME
+    trainer_state/template/load surface (duck-typed): params + adam
+    moments + update counters round-trip bitwise onto the captured
+    path's sharded placements."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(3, in_units=16))
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    mesh = parallel.make_mesh(dp=2, tp=4)
+    net = build()
+    parallel.shard_model(net, mesh, mode="fsdp", min_size=8)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    rng = np.random.RandomState(1)
+    batches = [(rng.randn(16, 8).astype(np.float32),
+                rng.randint(0, 3, (16,)).astype(np.float32))
+               for _ in range(4)]
+    for x, y in batches[:2]:
+        tr.train_step(net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    st = checkpoint.trainer_state(tr)
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    ck.save(2, st)
+    # train on, then restore: must rewind bitwise
+    for x, y in batches[2:]:
+        tr.train_step(net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    restored = ck.restore(2, template=checkpoint.trainer_state_template(tr))
+    checkpoint.load_trainer_state(tr, restored)
+    for p, want in zip(tr._params, st["params"]):
+        assert np.array_equal(p.data().asnumpy(), want)
+    assert tr._optimizer.num_update == int(st["num_update"])
+    # the restored trainer still trains on the sharded placements
+    for x, y in batches[2:]:
+        tr.train_step(net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    parallel.set_default_mesh(None)
+
+
 # -- integration: rollback / preemption / run_resilient / factory --------------
 
 def test_async_save_overlapped_with_rollback(tmp_path, monkeypatch):

@@ -27,6 +27,115 @@ def test_mesh_axes():
         parallel.make_mesh(dp=100)
 
 
+@pytest.mark.parametrize("axis", ["dp", "tp", "pp", "sp", "ep"])
+def test_make_mesh_overflow_message_per_axis(axis):
+    """Mismatch raises OUR ValueError naming the axis product and the
+    device count — not whatever jax raises from a bad reshape."""
+    n = len(jax.devices())
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(**{axis: n + 1})
+    msg = str(ei.value)
+    assert f"{axis}={n + 1}" in msg
+    assert str(n + 1) in msg and str(n) in msg
+    assert "jax.devices()" in msg
+
+
+def test_make_mesh_overflow_product_named():
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(dp=4, tp=4)
+    msg = str(ei.value)
+    assert "dp=4 * tp=4 = 16" in msg
+
+
+def test_make_mesh_devices_override():
+    devs = jax.devices()[:4]
+    mesh = parallel.make_mesh(dp=2, tp=2, devices=devs)
+    assert mesh.shape == {"dp": 2, "tp": 2}
+    assert set(mesh.devices.flat) == set(devs)
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(dp=8, devices=devs)
+    assert "devices= override" in str(ei.value)
+    assert "only 4 available" in str(ei.value)
+
+
+def test_make_mesh_rejects_bad_axis_values():
+    for bad in (0, -1, 2.0, "2"):
+        with pytest.raises(ValueError):
+            parallel.make_mesh(dp=bad)
+
+
+# -- ShardingRules resolution order (pinned semantics) -------------------------
+
+def test_sharding_rules_first_match_wins():
+    """Resolution is FIRST match in insertion order, not most-specific:
+    the broad rule inserted first shadows the narrower one after it."""
+    rules = parallel.ShardingRules(rules=[
+        (r"weight$", ("tp", None)),
+        (r"special_weight$", (None, "tp")),
+    ])
+    assert tuple(rules.spec_for("special_weight")) == ("tp", None)
+    # swapping the insertion order flips the winner
+    rules2 = parallel.ShardingRules(rules=[
+        (r"special_weight$", (None, "tp")),
+        (r"weight$", ("tp", None)),
+    ])
+    assert tuple(rules2.spec_for("special_weight")) == (None, "tp")
+
+
+def test_sharding_rules_spec_for_shape_none_and_default():
+    rules = parallel.ShardingRules(rules=[(r"w$", ("tp",))],
+                                   default=("dp",))
+    # shape=None is always legal on regex rules
+    assert tuple(rules.spec_for("layer_w", shape=None)) == ("tp",)
+    # no match falls to the rule set's default
+    assert tuple(rules.spec_for("unmatched_bias")) == ("dp",)
+    assert tuple(parallel.ShardingRules().spec_for("anything")) == ()
+
+
+def test_combined_rules_override_semantics():
+    """Every rule of an earlier set outranks every rule of a later set;
+    `add` on the combination appends at LOWEST precedence."""
+    a = parallel.ShardingRules(rules=[(r"weight$", ("tp", None))])
+    b = parallel.ShardingRules(rules=[(r"weight$", (None, "tp")),
+                                      (r"bias$", ("tp",))])
+    combo = parallel.combined_rules(a, b)
+    assert tuple(combo.spec_for("x_weight")) == ("tp", None)   # a wins
+    assert tuple(combo.spec_for("x_bias")) == ("tp",)          # b fills in
+    combo.add(r"bias$", (None,))
+    assert tuple(combo.spec_for("x_bias")) == ("tp",)  # b still outranks
+    combo2 = parallel.combined_rules(a).add(r"gamma$", ("dp",))
+    assert tuple(combo2.spec_for("bn_gamma")) == ("dp",)
+
+
+def test_combined_rules_fsdp_shape_heuristic_ordering():
+    """TP-in-front-of-FSDP: the regex rule claims matching names, the
+    shape heuristic of the LATER set covers the rest."""
+    tp = parallel.ShardingRules(rules=[(r"qkv_weight$", ("tp", None))])
+    combo = parallel.combined_rules(
+        tp, parallel.FSDPRules(axis_size=4, min_size=16))
+    assert tuple(combo.spec_for("l0_qkv_weight", (12, 8))) == ("tp", None)
+    assert tuple(combo.spec_for("l0_other_weight", (8, 4))) == ("dp", None)
+
+
+def test_fsdp_rules_shape_heuristic():
+    rules = parallel.FSDPRules(axis_size=4, min_size=16)
+    assert tuple(rules.spec_for("w", (8, 4))) == ("dp", None)
+    # first divisible dim wins; dim0=6 not divisible by 4, dim1=8 is
+    assert tuple(rules.spec_for("w", (6, 8))) == (None, "dp")
+    assert tuple(rules.spec_for("b", (3,))) == ()        # < min_size
+    assert tuple(rules.spec_for("w", (6, 7))) == ()      # nothing divides
+    assert tuple(rules.spec_for("w", None)) == ()        # unknown shape
+    assert tuple(rules.spec_for("w", (4, 4, 4))) == ("dp", None, None)
+
+
+def test_match_partition_rules_bulk():
+    rules = parallel.ShardingRules(rules=[(r"weight$", ("tp", None))])
+    specs = parallel.match_partition_rules(
+        rules, {"a_weight": (8, 4), "a_bias": (8,)})
+    assert tuple(specs["a_weight"]) == ("tp", None)
+    assert tuple(specs["a_bias"]) == ()
+
+
 def test_ring_attention_matches_dense(qkv):
     q, k, v = qkv
     mesh = parallel.make_mesh(sp=8)
