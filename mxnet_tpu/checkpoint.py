@@ -704,6 +704,30 @@ class AsyncCheckpointer:
             sys.stderr.write(f"[checkpoint] {msg}\n")
 
 
+def latest_manifest_step(directory):
+    """Newest committed step in ``directory`` (a ``step_*`` dir with
+    MANIFEST.json), or None.  A cheap directory scan — the serving
+    reload poller calls this every MXTPU_SERVE_RELOAD_POLL_MS without
+    instantiating an AsyncCheckpointer."""
+    directory = os.fspath(directory)
+    best = None
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        try:
+            s = int(name[5:])
+        except ValueError:
+            continue
+        if (best is None or s > best) and os.path.exists(
+                os.path.join(directory, name, "MANIFEST.json")):
+            best = s
+    return best
+
+
 def _remove_quiet(path):
     try:
         os.remove(path)

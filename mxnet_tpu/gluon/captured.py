@@ -245,6 +245,16 @@ def _collect_blocks_params(block, loss_fn):
 _MAX_CACHE = 8
 
 
+def capture_cache_size():
+    """FIFO capacity of the per-trainer capture cache.  Overridable via
+    MXTPU_CAPTURE_CACHE (min 1): the default of 8 is enough for training
+    configurations, but a process that also serves holds one AOT program
+    per (batch × seq) bucket and needs head-room."""
+    from ..base import getenv_int
+
+    return max(1, getenv_int("MXTPU_CAPTURE_CACHE", _MAX_CACHE))
+
+
 def get_step(trainer, block, loss_fn, data, label, grad_accum):
     """Return the (possibly cached) `CapturedStep` for this call
     signature, or None when the step must run on the eager oracle.
@@ -318,8 +328,17 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
                         guard_on=guard_on, clip=clip,
                         has_scaler=has_scaler, grad_accum=k,
                         has_label=label is not None, mesh=mesh)
-    while len(cache) >= _MAX_CACHE:
-        cache.pop(next(iter(cache)))
+    cap = capture_cache_size()
+    while len(cache) >= cap:
+        evicted_key = next(iter(cache))
+        cache.pop(evicted_key)
+        # an eviction means the NEXT hit on that signature recompiles —
+        # on a serving/training hybrid that is a latency cliff, so it is
+        # always worth a telemetry line
+        from .. import telemetry as _telemetry
+
+        _telemetry.event("capture_cache_evict", cache_size=cap,
+                         kept=len(cache))
     cache[key] = step
     return step
 

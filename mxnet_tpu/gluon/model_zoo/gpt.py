@@ -181,6 +181,73 @@ def gpt_tiny(**kwargs):
 # reference's inference-time BucketingModule/exec cache plays for RNNs).
 
 
+#: the scanned-trunk parameter stacks every cached/serving decoder runs on
+STACK_NAMES = ("qkv_stack_weight", "qkv_stack_bias",
+               "proj_stack_weight", "proj_stack_bias",
+               "ffn1_stack_weight", "ffn1_stack_bias",
+               "ffn2_stack_weight", "ffn2_stack_bias",
+               "ln1_stack_gamma", "ln1_stack_beta",
+               "ln2_stack_gamma", "ln2_stack_beta")
+
+
+def extract_decoder_stacks(model):
+    """Pull a GPTModel's trunk parameters into (L, ...) stacks, for scan
+    and unstacked trunks alike.  Returns
+    ``(stacks, (lnf_gamma, lnf_beta), tok_embed, pos_embed, num_heads,
+    activation)`` — the single weight-extraction home shared by
+    CachedDecoder and the serving tier (mxnet_tpu/serving/engine.py),
+    so both consume the exact same layout."""
+    params = dict(model.collect_params())
+
+    def get1(suffix):
+        ks = [k for k in params if k.endswith(suffix)]
+        assert len(ks) == 1, (suffix, ks)
+        return params[ks[0]].data()._data
+
+    if any(k.endswith("qkv_stack_weight") for k in params):
+        stacks = {nm: get1(nm) for nm in STACK_NAMES}
+        lnf_g, lnf_b = get1("lnf_gamma"), get1("lnf_beta")
+        num_heads = model.encoder._num_heads
+        act = model.encoder._activation
+    else:
+        enc = model.encoder
+        layers = list(enc.layers._children.values())
+        num_heads = layers[0]._num_heads
+        act = layers[0]._activation
+
+        def stacked(name):
+            import jax.numpy as jnp
+
+            return jnp.stack([
+                getattr(l, name).data()._data for l in layers])
+
+        stacks = {
+            "qkv_stack_weight": stacked("qkv_weight"),
+            "qkv_stack_bias": stacked("qkv_bias"),
+            "proj_stack_weight": stacked("proj_weight"),
+            "proj_stack_bias": stacked("proj_bias"),
+            "ffn1_stack_weight": stacked("ffn1_weight"),
+            "ffn1_stack_bias": stacked("ffn1_bias"),
+            "ffn2_stack_weight": stacked("ffn2_weight"),
+            "ffn2_stack_bias": stacked("ffn2_bias"),
+        }
+        import jax.numpy as jnp
+
+        stacks["ln1_stack_gamma"] = jnp.stack(
+            [l.ln1.gamma.data()._data for l in layers])
+        stacks["ln1_stack_beta"] = jnp.stack(
+            [l.ln1.beta.data()._data for l in layers])
+        stacks["ln2_stack_gamma"] = jnp.stack(
+            [l.ln2.gamma.data()._data for l in layers])
+        stacks["ln2_stack_beta"] = jnp.stack(
+            [l.ln2.beta.data()._data for l in layers])
+        lnf_g = enc.ln_f.gamma.data()._data
+        lnf_b = enc.ln_f.beta.data()._data
+
+    return (stacks, (lnf_g, lnf_b), get1("tok_embed_weight"),
+            get1("pos_embed_weight"), num_heads, act)
+
+
 class CachedDecoder:
     """Wraps a GPTModel into jitted prefill/step functions.
 
@@ -199,63 +266,12 @@ class CachedDecoder:
         self._mesh = mesh
         self._tp_axis = tp_axis
         self._dtype = dtype
-        params = dict(model.collect_params())
-
-        def get1(suffix):
-            ks = [k for k in params if k.endswith(suffix)]
-            assert len(ks) == 1, (suffix, ks)
-            return params[ks[0]].data()._data
-
-        if any(k.endswith("qkv_stack_weight") for k in params):
-            stacks = {nm: get1(nm) for nm in (
-                "qkv_stack_weight", "qkv_stack_bias",
-                "proj_stack_weight", "proj_stack_bias",
-                "ffn1_stack_weight", "ffn1_stack_bias",
-                "ffn2_stack_weight", "ffn2_stack_bias",
-                "ln1_stack_gamma", "ln1_stack_beta",
-                "ln2_stack_gamma", "ln2_stack_beta")}
-            lnf_g, lnf_b = get1("lnf_gamma"), get1("lnf_beta")
-            num_heads = model.encoder._num_heads
-            act = model.encoder._activation
-        else:
-            enc = model.encoder
-            layers = list(enc.layers._children.values())
-            num_heads = layers[0]._num_heads
-            act = layers[0]._activation
-
-            def stacked(name):
-                import jax.numpy as jnp
-
-                return jnp.stack([
-                    getattr(l, name).data()._data for l in layers])
-
-            stacks = {
-                "qkv_stack_weight": stacked("qkv_weight"),
-                "qkv_stack_bias": stacked("qkv_bias"),
-                "proj_stack_weight": stacked("proj_weight"),
-                "proj_stack_bias": stacked("proj_bias"),
-                "ffn1_stack_weight": stacked("ffn1_weight"),
-                "ffn1_stack_bias": stacked("ffn1_bias"),
-                "ffn2_stack_weight": stacked("ffn2_weight"),
-                "ffn2_stack_bias": stacked("ffn2_bias"),
-            }
-            import jax.numpy as jnp
-
-            stacks["ln1_stack_gamma"] = jnp.stack(
-                [l.ln1.gamma.data()._data for l in layers])
-            stacks["ln1_stack_beta"] = jnp.stack(
-                [l.ln1.beta.data()._data for l in layers])
-            stacks["ln2_stack_gamma"] = jnp.stack(
-                [l.ln2.gamma.data()._data for l in layers])
-            stacks["ln2_stack_beta"] = jnp.stack(
-                [l.ln2.beta.data()._data for l in layers])
-            lnf_g = enc.ln_f.gamma.data()._data
-            lnf_b = enc.ln_f.beta.data()._data
-
+        (stacks, (lnf_g, lnf_b), tok, pos,
+         num_heads, act) = extract_decoder_stacks(model)
         self._stacks = stacks
         self._lnf = (lnf_g, lnf_b)
-        self._tok = get1("tok_embed_weight")
-        self._pos = get1("pos_embed_weight")
+        self._tok = tok
+        self._pos = pos
         if dtype is not None:
             # Serving precision: the BIG tensors (weight stacks, embed
             # tables, and — via self._tok.dtype — the KV cache) go

@@ -150,6 +150,23 @@ TRANSFORMER_TP_RULES = ShardingRules(rules=[
     (r"ffn2_stack_weight$", (None, None, TP)),
 ], default=())
 
+# serving KV cache: stage-major (L, B, H, W, Dh) along the scanned
+# trunk — heads shard on the tp axis exactly like the qkv stacks above,
+# so cached keys/values stay resident with the heads that produced them
+SERVING_CACHE_AXES = (None, None, TP, None, None)
+
+
+def serving_cache_sharding(mesh, tp_axis=TP):
+    """NamedSharding for a (L, B, H, W, Dh) serving KV cache on ``mesh``
+    (None mesh → None, the single-device path)."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = tuple(tp_axis if a == TP else a for a in SERVING_CACHE_AXES)
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
 # expert parallelism: MoE expert weights shard on their leading E axis
 # (gluon/contrib/moe.py MoEFFN); the router gate stays replicated so
 # every ep slice routes identically

@@ -1,0 +1,157 @@
+"""Continuous batcher: coalesce concurrent requests into bucketed groups.
+
+One daemon thread owns the engine.  Submitters get a
+``concurrent.futures.Future`` back immediately; the loop collects
+requests until either the latency deadline (``MXTPU_SERVE_MAX_DELAY_MS``
+past the FIRST queued request — later arrivals don't extend it) or the
+largest batch bucket is reached, serves the group through ONE bucketed
+AOT dispatch sequence, and resolves every future.
+
+The deadline is the latency/throughput dial: 0 serves each request the
+moment the engine is free (lowest latency, no coalescing); a few ms lets
+concurrent clients share a prefill+decode pass (the padded rows are
+nearly free, so tokens/sec scales with the bucket fill).
+
+``before_batch`` runs between groups with the engine idle — the hook
+serving/replica.py uses to hot-swap reloaded weights with zero dropped
+requests.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+from .. import telemetry
+
+
+def max_delay_ms_from_env(default=5.0):
+    raw = os.environ.get("MXTPU_SERVE_MAX_DELAY_MS")
+    if not raw:
+        return default
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return default
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new_tokens", "future", "t_enqueue")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class ContinuousBatcher:
+    """Queue + serving loop over a ServingEngine.
+
+    ``submit(prompt, max_new_tokens)`` → Future resolving to a dict:
+    ``tokens`` (np.int32 generated ids) plus the per-request record
+    fields (queue_us, prefill_us, decode_us_per_token, bucket,
+    padded_fraction, generation).
+    """
+
+    def __init__(self, engine, max_delay_ms=None, max_batch=None,
+                 before_batch=None, temperature=None, rng=None):
+        self.engine = engine
+        self.max_delay_ms = (max_delay_ms_from_env()
+                             if max_delay_ms is None else max_delay_ms)
+        self.max_batch = max_batch or max(engine.batch_buckets)
+        self.before_batch = before_batch
+        self._temperature = temperature
+        self._rng = rng
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        self.groups_served = 0
+        self.requests_served = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt, max_new_tokens=16):
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        req = _Request(prompt, max_new_tokens)
+        self._q.put(req)
+        return req.future
+
+    def _collect(self):
+        """Block for the first request, then coalesce until the deadline
+        or the largest bucket fills."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        group = [first]
+        deadline = first.t_enqueue + self.max_delay_ms / 1e3
+        while len(group) < self.max_batch:
+            wait = deadline - time.perf_counter()
+            if wait <= 0:
+                # deadline hit — grab whatever is already queued, no wait
+                try:
+                    while len(group) < self.max_batch:
+                        group.append(self._q.get_nowait())
+                except queue.Empty:
+                    pass
+                break
+            try:
+                group.append(self._q.get(timeout=wait))
+            except queue.Empty:
+                break
+        return group
+
+    def _serve(self, group):
+        t_batch = time.perf_counter()
+        try:
+            if self.before_batch is not None:
+                self.before_batch()
+            outs, timings = self.engine.serve_group(
+                [r.prompt for r in group],
+                [r.max_new_tokens for r in group],
+                temperature=self._temperature, rng=self._rng)
+        except BaseException as exc:  # resolve ALL futures, never hang
+            for r in group:
+                if not r.future.cancelled():
+                    r.future.set_exception(exc)
+            return
+        self.groups_served += 1
+        self.requests_served += len(group)
+        for r, toks in zip(group, outs):
+            queue_us = (t_batch - r.t_enqueue) * 1e6
+            rec = dict(timings)
+            rec["queue_us"] = queue_us
+            rec["tokens"] = toks
+            telemetry.request_record(
+                queue_us=queue_us,
+                prefill_us=timings["prefill_us"],
+                decode_us_per_token=timings["decode_us_per_token"],
+                bucket=timings["bucket"],
+                padded_fraction=timings["padded_fraction"],
+                new_tokens=len(toks),
+                generation=timings["generation"])
+            if not r.future.cancelled():
+                r.future.set_result(rec)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            group = self._collect()
+            if group:
+                self._serve(group)
+        # drain: resolve what is left rather than abandoning futures
+        while True:
+            try:
+                group = [self._q.get_nowait()]
+            except queue.Empty:
+                break
+            self._serve(group)
+
+    def close(self, timeout=30.0):
+        """Stop the loop; queued requests are still served (drained)."""
+        self._stop.set()
+        self._thread.join(timeout)

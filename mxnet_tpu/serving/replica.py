@@ -1,0 +1,193 @@
+"""Multi-replica front door with hot model reload.
+
+``ReplicaServer`` = one engine + one ContinuousBatcher + a checkpoint
+poller.  The poller watches an AsyncCheckpointer directory for a newer
+committed ``MANIFEST.json`` (checkpoint.latest_manifest_step), restores
+the state dict OFF the serving thread, and stages it; the batcher's
+``before_batch`` hook applies the staged swap between groups — the
+engine's weights are program *arguments*, so the swap is an array
+replacement, no recompile, and in-flight requests are never dropped
+(they either run on the old generation or the new one, never on a
+half-swapped set).
+
+``FrontDoor`` spreads requests over a replica group round-robin,
+supervised by the PR 8 health plane: each replica publishes heartbeats
+to the shared FileKV, a FailureDetector marks silent replicas dead, and
+submission fails over to the next live replica.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import telemetry
+from ..base import MXNetError, getenv_int
+from .batcher import ContinuousBatcher
+
+
+def reload_poll_ms_from_env(default=200):
+    return max(1, getenv_int("MXTPU_SERVE_RELOAD_POLL_MS", default))
+
+
+class ReplicaServer:
+    """One serving replica: batcher + checkpoint-driven hot reload.
+
+    ``ckpt_dir``: AsyncCheckpointer directory to poll (None disables
+    reload).  ``kv``/``rank``: FileKV control plane for heartbeats (the
+    FrontDoor's failure detector watches them).
+    """
+
+    def __init__(self, engine, ckpt_dir=None, poll_ms=None, kv=None,
+                 rank=0, max_delay_ms=None, max_batch=None,
+                 temperature=None, rng=None):
+        self.engine = engine
+        self.rank = rank
+        self._ckpt_dir = os.fspath(ckpt_dir) if ckpt_dir else None
+        self._poll_ms = (reload_poll_ms_from_env()
+                         if poll_ms is None else poll_ms)
+        self.loaded_step = None
+        self._fetched_step = None    # newest step the poller restored
+        self._staged = None          # (step, state) awaiting swap
+        self._staged_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.reloads = 0
+        self.batcher = ContinuousBatcher(
+            engine, max_delay_ms=max_delay_ms, max_batch=max_batch,
+            before_batch=self._maybe_swap, temperature=temperature,
+            rng=rng)
+        self._hb = None
+        if kv is not None:
+            from ..resilience import HeartbeatPublisher
+
+            self._hb = HeartbeatPublisher(kv, rank)
+            self._hb.start()
+        self._poller = None
+        if self._ckpt_dir is not None:
+            self._poller = threading.Thread(
+                target=self._poll_loop, name=f"mxtpu-reload-{rank}",
+                daemon=True)
+            self._poller.start()
+
+    def submit(self, prompt, max_new_tokens=16):
+        return self.batcher.submit(prompt, max_new_tokens)
+
+    # -- hot reload ------------------------------------------------------------
+
+    def poll_once(self):
+        """Check the manifest; restore + stage a newer step.  Runs on
+        the poller thread — the expensive host restore happens here,
+        never on the serving thread."""
+        from .. import checkpoint
+
+        step = checkpoint.latest_manifest_step(self._ckpt_dir)
+        # _fetched_step (poller-thread-private) is the dedup, NOT
+        # loaded_step: a step staged but not yet swapped by the batcher
+        # must not be restored (and swapped) a second time
+        if step is None or step == self._fetched_step:
+            return False
+        ck = checkpoint.AsyncCheckpointer(
+            self._ckpt_dir, rank=0, world_size=1)
+        state = ck.restore(step=step)
+        self._fetched_step = step
+        with self._staged_lock:
+            self._staged = (step, state)
+        return True
+
+    def _poll_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as exc:
+                telemetry.event("serving_reload_error", rank=self.rank,
+                                error=f"{type(exc).__name__}: {exc}")
+            self._stop.wait(self._poll_ms / 1e3)
+
+    def _maybe_swap(self):
+        """Apply a staged reload — called by the batcher BETWEEN groups,
+        with the engine idle, so no request ever sees a half-swap."""
+        with self._staged_lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        step, state = staged
+        self.engine.reload_from_state(state, step=step)
+        self.loaded_step = step
+        self.reloads += 1
+
+    def close(self, timeout=30.0):
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout)
+        if self._hb is not None:
+            self._hb.stop()
+        self.batcher.close(timeout)
+
+
+class FrontDoor:
+    """Round-robin request router over a replica group with failover.
+
+    With a FileKV the PR 8 FailureDetector confirms dead replicas from
+    heartbeat silence; without one, only local submit failures mark a
+    replica out.
+    """
+
+    def __init__(self, replicas, kv=None, timeout=None):
+        if not replicas:
+            raise MXNetError("FrontDoor: need at least one replica")
+        self.replicas = list(replicas)
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._failed = set()
+        self._detector = None
+        if kv is not None:
+            from ..resilience import FailureDetector
+
+            self._detector = FailureDetector(
+                kv, -1, [r.rank for r in self.replicas],
+                timeout=timeout)
+
+    def alive(self):
+        """Replicas not confirmed dead (detector) nor locally failed."""
+        dead = set(self._failed)
+        if self._detector is not None:
+            dead |= set(self._detector.poll())
+        return [r for r in self.replicas if r.rank not in dead]
+
+    def submit(self, prompt, max_new_tokens=16):
+        """Submit to the next live replica; fail over on submit error."""
+        live = self.alive()
+        if not live:
+            raise MXNetError("FrontDoor: no live replicas")
+        with self._lock:
+            start = self._rr
+            self._rr += 1
+        last_exc = None
+        for i in range(len(live)):
+            r = live[(start + i) % len(live)]
+            try:
+                return r.submit(prompt, max_new_tokens)
+            except Exception as exc:
+                last_exc = exc
+                self._failed.add(r.rank)
+                telemetry.event("serving_replica_failover", rank=r.rank,
+                                error=f"{type(exc).__name__}: {exc}")
+        raise MXNetError(
+            f"FrontDoor: every replica refused the request "
+            f"(last: {last_exc})")
+
+    def close(self, timeout=30.0):
+        for r in self.replicas:
+            r.close(timeout)
+
+
+def _wait_all(futures, timeout=None):
+    """Resolve a list of serving futures → list of result dicts."""
+    deadline = None if timeout is None else time.perf_counter() + timeout
+    out = []
+    for f in futures:
+        left = None if deadline is None \
+            else max(0.0, deadline - time.perf_counter())
+        out.append(f.result(timeout=left))
+    return out

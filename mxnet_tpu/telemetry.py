@@ -325,6 +325,39 @@ def event(kind, **fields):
     _emit(rec)
 
 
+def request_record(queue_us, prefill_us, decode_us_per_token, bucket,
+                   padded_fraction, new_tokens=None, generation=None,
+                   **fields):
+    """Emit one per-request serving record (the serving analogue of a
+    StepStats row): queue wait, prefill latency, per-token decode
+    latency, the (batch, seq) bucket the request was padded into, and
+    the padding overhead it paid.  tools/trace_report.py aggregates
+    these into the per-request p50/p99 section."""
+    if not enabled():
+        return
+    rec = {"type": "request", "v": SCHEMA_VERSION, "run": _RUN_ID,
+           "t": time.time(),
+           "queue_us": round(float(queue_us), 1),
+           "prefill_us": round(float(prefill_us), 1),
+           "decode_us_per_token": round(float(decode_us_per_token), 1),
+           "bucket": [int(b) for b in bucket],
+           "padded_fraction": float(padded_fraction)}
+    if new_tokens is not None:
+        rec["new_tokens"] = int(new_tokens)
+    if generation is not None:
+        rec["generation"] = int(generation)
+    for k, v in fields.items():
+        if v is not None:
+            rec[k] = v
+    _emit(rec)
+
+
+def recent_requests():
+    """The in-memory ring of per-request serving records, oldest first."""
+    with _LOCK:
+        return [r for r in _RECENT if r.get("type") == "request"]
+
+
 # -- per-step assembly ---------------------------------------------------------
 
 #: counters whose per-step DELTA lands in each StepStats record
@@ -653,14 +686,32 @@ def validate_record(rec):
     if not isinstance(rec, dict):
         fail("not an object")
     kind = rec.get("type")
-    if kind not in ("step", "event"):
-        fail(f"type must be 'step'|'event', got {kind!r}")
+    if kind not in ("step", "event", "request"):
+        fail(f"type must be 'step'|'event'|'request', got {kind!r}")
     if not isinstance(rec.get("run"), str) or not rec["run"]:
         fail("missing run id")
     if not isinstance(rec.get("t"), (int, float)):
         fail("missing timestamp t")
     if rec.get("v") != SCHEMA_VERSION:
         fail(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    if kind == "request":
+        for key in ("queue_us", "prefill_us", "decode_us_per_token"):
+            val = rec.get(key)
+            if not isinstance(val, (int, float)) or val < 0:
+                fail(f"{key} must be a non-negative number")
+        bucket = rec.get("bucket")
+        if not (isinstance(bucket, list) and len(bucket) == 2 and
+                all(isinstance(b, int) and b > 0 for b in bucket)):
+            fail("bucket must be [batch, seq] positive ints")
+        pf = rec.get("padded_fraction")
+        if not isinstance(pf, (int, float)) or not 0 <= pf < 1:
+            fail("padded_fraction must be a number in [0, 1)")
+        for key in ("new_tokens", "generation"):
+            val = rec.get(key)
+            if val is not None and \
+                    (not isinstance(val, int) or val < 0):
+                fail(f"{key} must be a non-negative int or absent")
+        return rec
     if kind == "event":
         if not isinstance(rec.get("event"), str) or not rec["event"]:
             fail("event record missing event kind")

@@ -75,6 +75,14 @@ def test_example_gpt_char_lm():
     assert "char-LM OK" in out
 
 
+def test_example_serve_gpt():
+    out = _run("serve_gpt.py", "--steps", "8", "--requests", "4",
+               "--new-tokens", "4", timeout=500)
+    assert "hot reloads applied" in out
+    assert "retraces after warmup: 0" in out
+    assert out.strip().endswith("ok")
+
+
 def test_example_gpt_pretrain_sharded():
     out = _run("gpt_pretrain_sharded.py", "--model", "gpt_tiny",
                "--steps", "12", "--batch-size", "8", "--seq-len", "32",

@@ -57,6 +57,15 @@ def _mean(vals):
     return sum(vals) / len(vals) if vals else None
 
 
+def _pctl(vals, q):
+    """Nearest-rank percentile over a non-empty list (stdlib-only)."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, int(round(q / 100.0 * len(vals))) - 1))
+    return vals[idx]
+
+
 def _fmt(v, nd=1):
     return "-" if v is None else f"{v:.{nd}f}"
 
@@ -64,8 +73,11 @@ def _fmt(v, nd=1):
 def report_run(run, records, out):
     steps = [r for r in records if r.get("type") == "step"]
     events = [r for r in records if r.get("type") == "event"]
+    requests = [r for r in records if r.get("type") == "request"]
     out.write(f"run {run}: {len(steps)} step records, "
-              f"{len(events)} events\n")
+              f"{len(events)} events, {len(requests)} requests\n")
+    if requests:
+        report_requests(requests, out)
     if steps:
         wall = _mean([s.get("wall_us") for s in steps])
         interval = _mean([s.get("interval_us") for s in steps])
@@ -107,6 +119,36 @@ def report_run(run, records, out):
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
+
+
+def report_requests(requests, out):
+    """Per-request serving section: latency percentiles for each stage
+    of the request path plus the padding overhead the bucket policy
+    cost (schema: the 'request' record in docs/observability.md)."""
+    out.write("  serving requests:\n")
+    out.write(f"    {'stage':<22}{'p50 us':>12}{'p99 us':>12}\n")
+    for key, label in (("queue_us", "queue"),
+                       ("prefill_us", "prefill"),
+                       ("decode_us_per_token", "decode/token")):
+        vals = [r.get(key) for r in requests]
+        out.write(f"    {label:<22}{_fmt(_pctl(vals, 50)):>12}"
+                  f"{_fmt(_pctl(vals, 99)):>12}\n")
+    pf = _mean([r.get("padded_fraction") for r in requests])
+    out.write(f"    mean padded_fraction {_fmt(pf, 4)}\n")
+    buckets = {}
+    for r in requests:
+        b = r.get("bucket")
+        if isinstance(b, list) and len(b) == 2:
+            key = f"{b[0]}x{b[1]}"
+            buckets[key] = buckets.get(key, 0) + 1
+    if buckets:
+        hist = "  ".join(f"{k}:{buckets[k]}" for k in sorted(buckets))
+        out.write(f"    buckets (batch x seq): {hist}\n")
+    gens = sorted({r["generation"] for r in requests
+                   if r.get("generation") is not None})
+    if len(gens) > 1:
+        out.write(f"    weight generations served: {gens} "
+                  f"(hot reload mid-run)\n")
 
 
 def report_resilience(kinds, out):
