@@ -459,6 +459,415 @@ def bench_recovery(errors):
     return out or None
 
 
+# -- fleet bench (traffic-elastic control plane) -------------------------------
+
+def _fleet_gang_thread(res, dist, np, server, rank, world, num_steps,
+                       snap_every, out, *, hb_timeout=5.0, step_s=0.0,
+                       join=False, die_at=None, leave_after=None,
+                       preempt_after=None, policy_kw=None):
+    """One in-process rank of a fleet-bench thread gang over TcpKV.
+
+    Measurement hooks: ``reshape_ms`` is the wall-clock from the
+    attempt that raised RankFailure to recover() returning — for a
+    planned drain that is pure reshape cost, for a silent death it
+    includes the detection window, which is exactly the comparison the
+    drain protocol exists to win.  ``computed`` counts loss
+    computations, so ``computed - len(losses)`` is the redone-step bill
+    of each reshape (zero for a planned one)."""
+    import threading
+    kv = None
+    gang = None
+    try:
+        kv = dist.TcpKV(server.addr, rank=rank)
+        gang = res.ElasticGang(rank, world, kv=kv,
+                               peer_snap_every=snap_every,
+                               heartbeat_interval=0.05,
+                               heartbeat_timeout=hb_timeout)
+        policy = res.ScalePolicy(gang, **policy_kw) if policy_kw else None
+        state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+        step, losses, computed = 0, {}, 0
+        reshapes, reshape_ms = 0, []
+        planned_at = preempt_trigger = None
+        rec = {"rank": rank, "gang": gang, "kv": kv, "policy": policy,
+               "losses": losses, "reshape_ms": reshape_ms}
+        if join:
+            info = gang.join()
+            st = info.shards.get(rank)
+            if st is None:              # fresh joiner: adopt a replica
+                st = dict(next(iter(info.shards.values())))
+                st["opt"] = 0.0
+            state = {"w": np.array(st["w"], dtype=np.float64),
+                     "opt": float(st["opt"])}
+            step = info.snap_step
+            if preempt_after is not None:
+                preempt_trigger = step + preempt_after
+        else:
+            gang.start()
+        while step < num_steps:
+            if die_at is not None and step == die_at:
+                gang.hb.stop()          # silent death: no heartbeat
+                out[rank] = dict(rec, status="died", computed=computed)
+                return
+            if leave_after is not None and step == leave_after \
+                    and planned_at is None:
+                planned_at = gang.plan_leave(step + gang.drain_margin)
+            if preempt_trigger is not None and step == preempt_trigger:
+                res.ScalePolicy(gang, min_world=2).on_preemption(step)
+                preempt_trigger = None
+            t_try = time.monotonic()
+            try:
+                gang.step_tick(step, state=state)
+                epoch = gang.epoch
+                kv.put_json(f"red/{epoch}/{step}/{rank}",
+                            {"v": (rank + 1) * float(state["w"].sum())})
+                gang.barrier(f"red{step}")
+                total = sum(
+                    float(kv.get_json(f"red/{epoch}/{step}/{r}")["v"])
+                    for r in sorted(gang.members))
+                loss = total / len(gang.members)
+                computed += 1
+            except res.RankFailure as rf:
+                try:
+                    info = gang.recover(rf)
+                except res.GangEvicted:
+                    gang.stop()
+                    res.announce_freed_chips(kv, rank, step=step)
+                    out[rank] = dict(rec, status="evicted",
+                                     computed=computed)
+                    return
+                reshape_ms.append((time.monotonic() - t_try) * 1e3)
+                st = info.shards.get(rank)
+                if st is None:
+                    st = dict(next(iter(info.shards.values())))
+                    st["opt"] = 0.0
+                state = {"w": np.array(st["w"], dtype=np.float64),
+                         "opt": float(st["opt"])}
+                step = info.snap_step
+                reshapes += 1
+                continue
+            if policy is not None:
+                policy.observe(step, queue_depth=4.0, data_share=0.0)
+            losses[step] = loss
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss /
+                                                     state["w"].size)
+            state["opt"] += loss
+            step += 1
+            if step_s:
+                time.sleep(step_s)
+        out[rank] = dict(rec, status="done", computed=computed,
+                         reshapes=reshapes)
+    except Exception as e:              # noqa: BLE001 — surfaced
+        out[rank] = {"rank": rank, "status": "error", "error": repr(e),
+                     "gang": gang, "kv": kv, "losses": {},
+                     "reshape_ms": []}
+
+
+def _fleet_teardown(out, server):
+    for v in out.values():
+        g = v.get("gang")
+        if g is not None:
+            try:
+                g.stop()
+            except Exception:           # noqa: BLE001 — teardown
+                pass
+        c = v.get("kv")
+        if c is not None:
+            try:
+                c.close()
+            except Exception:           # noqa: BLE001 — teardown
+                pass
+    server.stop()
+
+
+def _fleet_reshape(res, dist, np, mode, errors):
+    """One 3-rank TcpKV thread gang losing rank 1 at step 5 — either as
+    a planned drain (``plan_leave``, no detection window, no redone
+    steps) or as a silent death (heartbeat-timeout detection + rollback
+    to the newest common snapshot).  Returns (mean reshape ms across
+    survivors, redone steps)."""
+    import threading
+    server = dist.GangKVServer(lease_ttl=5.0).start()
+    num_steps, snap_every, event_step = 12, 2, 5
+    out = {}
+    threads = [threading.Thread(
+        target=_fleet_gang_thread,
+        args=(res, dist, np, server, r, 3, num_steps, snap_every, out),
+        kwargs={"hb_timeout": 0.6 if mode == "detect" else 5.0,
+                "die_at": event_step if (mode == "detect" and r == 1)
+                else None,
+                "leave_after": event_step if (mode == "drain" and r == 1)
+                else None},
+        daemon=True) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        if any(t.is_alive() for t in threads):
+            errors.append(f"fleet/{mode}: gang wedged")
+            return None, None
+        if out.get(1, {}).get("status") not in ("died", "evicted"):
+            errors.append(f"fleet/{mode}: rank1 {out.get(1)}")
+            return None, None
+        ms, redone = [], 0
+        for r in (0, 2):
+            v = out.get(r)
+            if not v or v.get("status") != "done":
+                errors.append(f"fleet/{mode}: rank{r} {v and v.get('error')}")
+                return None, None
+            ms.extend(v["reshape_ms"])
+            redone += v["computed"] - len(v["losses"])
+        if not ms:
+            errors.append(f"fleet/{mode}: no reshape observed")
+            return None, None
+        return sum(ms) / len(ms), redone
+    finally:
+        _fleet_teardown(out, server)
+
+
+def _fleet_scale_cycle(res, dist, np, errors):
+    """Forced grow→shrink→grow driven by ScalePolicy over TcpKV: rank
+    0's policy sees a saturated input queue and publishes ``scale/req``;
+    a launcher thread consumes it and spawns a joiner (scheduled admit);
+    the joiner is then "preempted" — graceful drain + freed-chip
+    announcement — and the policy grows the gang again.  The bar is
+    zero lost steps on the base ranks across the whole cycle."""
+    import threading
+    server = dist.GangKVServer(lease_ttl=5.0).start()
+    num_steps, snap_every, step_s = 26, 2, 0.06
+    out = {}
+    policy_kw = {"min_world": 2, "max_world": 3, "window": 3,
+                 "cooldown": 0.5}
+    threads = [threading.Thread(
+        target=_fleet_gang_thread,
+        args=(res, dist, np, server, r, 2, num_steps, snap_every, out),
+        kwargs={"step_s": step_s,
+                "policy_kw": policy_kw if r == 0 else None},
+        daemon=True) for r in range(2)]
+    stop_launcher = threading.Event()
+
+    def launcher():
+        lkv = dist.TcpKV(server.addr, standby=False)
+        next_rank = 2
+        try:
+            while not stop_launcher.is_set() and next_rank <= 3:
+                req = lkv.get_json("scale/req")
+                if isinstance(req, dict):
+                    lkv.delete("scale/req")
+                    r = next_rank
+                    next_rank += 1
+                    t = threading.Thread(
+                        target=_fleet_gang_thread,
+                        args=(res, dist, np, server, r, 2, num_steps,
+                              snap_every, out),
+                        kwargs={"step_s": step_s, "join": True,
+                                "preempt_after": 4 if r == 2 else None},
+                        daemon=True)
+                    t.start()
+                    threads.append(t)
+                time.sleep(0.05)
+        finally:
+            try:
+                lkv.close()
+            except Exception:           # noqa: BLE001 — teardown
+                pass
+
+    lt = threading.Thread(target=launcher, daemon=True)
+    for t in threads:
+        t.start()
+    lt.start()
+    deadline = time.monotonic() + 90
+    for t in list(threads):
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    # the second joiner's thread is appended mid-run; join stragglers
+    for t in list(threads):
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    stop_launcher.set()
+    lt.join(timeout=10)
+    try:
+        if any(t.is_alive() for t in threads):
+            errors.append("fleet/cycle: gang wedged")
+            return None
+        lost = 0
+        for r in (0, 1):
+            v = out.get(r)
+            if not v or v.get("status") != "done":
+                errors.append(f"fleet/cycle: rank{r} "
+                              f"{v and (v.get('status'), v.get('error'))}")
+                return None
+            if sorted(v["losses"]) != list(range(num_steps)):
+                errors.append(f"fleet/cycle: rank{r} missed steps")
+                return None
+            lost += v["computed"] - len(v["losses"])
+        pol = out[0].get("policy")
+        freed = [k for k, _ in out[0]["kv"].scan("chips/freed")]
+        evicted = out.get(2, {}).get("status") == "evicted"
+        joined2 = out.get(3, {}).get("status") == "done"
+        return {"fleet_cycle_lost_steps": lost,
+                "fleet_cycle_grow_requests":
+                    pol.grow_requests if pol else None,
+                "fleet_cycle_drained": evicted,
+                "fleet_cycle_regrown": joined2,
+                "fleet_cycle_chips_freed": len(freed),
+                "fleet_cycle_final_world": len(out[0]["gang"].members)}
+    finally:
+        _fleet_teardown(out, server)
+
+
+def _fleet_failover(res, dist, errors):
+    """Coordinator death mid-run: rank 0's client promotes itself on
+    its standby socket, replays the state frame, rank 1 adopts — the
+    measured span is die() → the next successful mutation."""
+    stagger = os.environ.get("MXTPU_KV_FAILOVER_STAGGER")
+    os.environ["MXTPU_KV_FAILOVER_STAGGER"] = "0.1"
+    server = dist.GangKVServer(lease_ttl=1.0).start()
+    c0 = c1 = None
+    try:
+        c0 = dist.TcpKV(server.addr, rank=0)
+        c1 = dist.TcpKV(server.addr, rank=1)
+        c0.put_json("fleet/seed", {"v": 42})
+        c1.get_json("fleet/seed")
+        time.sleep(0.5)                 # a lease renewal refreshes the
+        server.die()                    # clients' failover state frames
+        t0 = time.monotonic()
+        c0.put_json("fleet/after", {"v": 1})
+        ms = (time.monotonic() - t0) * 1e3
+        if (c1.get_json("fleet/seed") or {}).get("v") != 42:
+            errors.append("fleet/failover: replayed state lost a write")
+            return None
+        if not c0.failovers:
+            errors.append("fleet/failover: no failover recorded")
+            return None
+        return round(ms, 1)
+    except Exception as e:              # noqa: BLE001 — surfaced
+        errors.append(f"fleet/failover: {e!r}")
+        return None
+    finally:
+        for c in (c1, c0):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:       # noqa: BLE001 — teardown
+                    pass
+        server.stop()
+        if stagger is None:
+            os.environ.pop("MXTPU_KV_FAILOVER_STAGGER", None)
+        else:
+            os.environ["MXTPU_KV_FAILOVER_STAGGER"] = stagger
+
+
+def _fleet_shed(errors):
+    """Bounded admission vs unbounded queueing at 2x the engine's
+    service rate: same stub engine, same offered load; the bounded
+    batcher sheds (ServerOverloaded) and keeps the p99 of ADMITTED
+    requests flat, the unbounded one lets the backlog grow and the p99
+    walk off with it."""
+    import threading
+    batcher_mod = _import_batcher()
+
+    class _StubEngine:
+        batch_buckets = (1, 2, 4)
+
+        def serve_group(self, prompts, maxes, temperature=None,
+                        rng=None):
+            time.sleep(0.01)            # 4-wide groups -> ~400 req/s
+            outs = [[1, 2, 3] for _ in prompts]
+            return outs, {"prefill_us": 10.0,
+                          "decode_us_per_token": 1.0,
+                          "bucket": [max(len(prompts), 1), 8],
+                          "padded_fraction": 0.0, "generation": 0}
+
+    def drive(max_queue):
+        b = batcher_mod.ContinuousBatcher(_StubEngine(),
+                                          max_delay_ms=0.5,
+                                          max_queue=max_queue)
+        lats, lock = [], threading.Lock()
+        shed = 0
+        interval, duration = 1.0 / 800.0, 0.5   # 2x capacity
+        t_end = time.monotonic() + duration
+        nxt = time.monotonic()
+        try:
+            while time.monotonic() < t_end:
+                t_sub = time.monotonic()
+                try:
+                    f = b.submit("p", 3)
+                except batcher_mod.ServerOverloaded:
+                    shed += 1
+                else:
+                    def done(fut, t=t_sub):
+                        with lock:
+                            lats.append(time.monotonic() - t)
+                    f.add_done_callback(done)
+                nxt += interval
+                delay = nxt - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+        finally:
+            try:
+                b.close(timeout=30)
+            except Exception:           # noqa: BLE001 — teardown
+                pass
+        with lock:
+            done_lats = sorted(lats)
+        if not done_lats:
+            return None, shed
+        p99 = done_lats[int(0.99 * (len(done_lats) - 1))] * 1e3
+        return round(p99, 1), shed
+
+    bounded_p99, shed = drive(batcher_mod.max_queue_from_env(default=8))
+    unbounded_p99, _ = drive(4096)
+    if bounded_p99 is None or unbounded_p99 is None:
+        errors.append("fleet/shed: no completed requests")
+        return None
+    if not shed:
+        errors.append("fleet/shed: bounded run shed nothing at 2x load")
+    return {"serve_shed_p99_ms": bounded_p99,
+            "serve_unbounded_p99_ms": unbounded_p99,
+            "serve_shed_count": shed,
+            "serve_shed_bounded": bounded_p99 < unbounded_p99}
+
+
+def bench_fleet(errors):
+    """Traffic-elastic fleet numbers (all jax-free, in-process thread
+    gangs over a real GangKVServer — no shared filesystem anywhere):
+
+    - fleet_drain_ms vs fleet_detected_ms: the SAME rank loss as a
+      planned drain vs a silent death.  The drain must be cheaper (no
+      detection window) and redo zero steps.
+    - fleet_cycle_*: a forced grow→shrink→grow ScalePolicy cycle with
+      zero lost steps on the base ranks.
+    - fleet_failover_ms: coordinator death → next successful mutation.
+    - serve_shed_*: bounded vs unbounded admission at 2x overload.
+    """
+    res, dist = _import_elastic()
+    import numpy as np
+
+    out = {}
+    drain_ms, drain_redone = _fleet_reshape(res, dist, np, "drain",
+                                            errors)
+    det_ms, det_redone = _fleet_reshape(res, dist, np, "detect", errors)
+    if drain_ms is not None:
+        out["fleet_drain_ms"] = round(drain_ms, 1)
+        out["fleet_drain_redone_steps"] = drain_redone
+    if det_ms is not None:
+        out["fleet_detected_ms"] = round(det_ms, 1)
+        out["fleet_detected_redone_steps"] = det_redone
+    if drain_ms is not None and det_ms is not None:
+        out["fleet_drain_cheaper"] = drain_ms < det_ms
+        out["fleet_drain_speedup"] = round(det_ms / drain_ms, 2) \
+            if drain_ms else None
+    fo = _fleet_failover(res, dist, errors)
+    if fo is not None:
+        out["fleet_failover_ms"] = fo
+    cycle = _fleet_scale_cycle(res, dist, np, errors)
+    if cycle:
+        out.update(cycle)
+    shed = _fleet_shed(errors)
+    if shed:
+        out.update(shed)
+    return out or None
+
+
 def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
@@ -571,6 +980,11 @@ def orchestrate():
     if headline is not None \
             and not os.environ.get("BENCH_SKIP_RECOVERY"):
         recovery = bench_recovery(recovery_errors)
+    fleet = None
+    fleet_errors = []
+    if headline is not None \
+            and not os.environ.get("BENCH_SKIP_FLEET"):
+        fleet = bench_fleet(fleet_errors)
     if headline is None:
         print(json.dumps({
             "metric": "resnet50_train_samples_per_sec_per_chip",
@@ -740,6 +1154,10 @@ def orchestrate():
         headline.update(recovery)
     if recovery_errors:
         headline["recovery_error"] = "; ".join(recovery_errors)[-300:]
+    if fleet:
+        headline.update(fleet)
+    if fleet_errors:
+        headline["fleet_error"] = "; ".join(fleet_errors)[-300:]
     _seal_trajectory_point(headline)
     print(json.dumps(headline))
     return 0
@@ -784,6 +1202,23 @@ def _import_elastic():
     res = importlib.import_module("mxnet_tpu.resilience")
     dist = importlib.import_module("mxnet_tpu.distributed")
     return res, dist
+
+
+def _import_batcher():
+    """Same bare-shell trick one level down: the serving batcher is
+    stdlib-only, but the ``mxnet_tpu.serving`` __init__ drags the jax
+    engine in — install a shell for the subpackage too and import the
+    batcher module directly."""
+    import importlib
+    import types
+
+    _import_elastic()                    # installs the mxnet_tpu shell
+    root = os.path.dirname(os.path.abspath(__file__))
+    if "mxnet_tpu.serving" not in sys.modules:
+        spkg = types.ModuleType("mxnet_tpu.serving")
+        spkg.__path__ = [os.path.join(root, "mxnet_tpu", "serving")]
+        sys.modules["mxnet_tpu.serving"] = spkg
+    return importlib.import_module("mxnet_tpu.serving.batcher")
 
 
 def gang_worker(cfg):

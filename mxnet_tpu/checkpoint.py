@@ -919,6 +919,34 @@ class PeerSnapshotStore:
             return sorted(s for s, (e, _, _) in d.items()
                           if e == int(epoch))
 
+    def held_ranks(self):
+        """Source ranks this store currently holds shards for."""
+        with self._lock:
+            return sorted(self._held)
+
+    def forget_rank(self, from_rank):
+        """Drop every shard held for a departed rank and withdraw its
+        advert — a drained peer's snapshots are dead weight once the
+        reshape commits (its shard layout matches the old world)."""
+        with self._lock:
+            dropped = self._held.pop(int(from_rank), None)
+        if dropped and self.kv is not None:
+            try:
+                self.kv.delete(f"held/{self.rank}/{int(from_rank)}")
+            except Exception:   # noqa: BLE001 — advert GC is best-effort
+                pass
+
+    def prune_ranks(self, members):
+        """Free shards held for ranks no longer in the gang.  Call only
+        once every surviving member is past shard assembly (the gang
+        does this on its first post-reshape snapshot) — pruning during
+        recovery itself races a slower survivor's fetch."""
+        keep = set(int(r) for r in members)
+        with self._lock:
+            gone = [r for r in self._held if r not in keep]
+        for r in gone:
+            self.forget_rank(r)
+
     # -- client ----------------------------------------------------------------
 
     def _addr_of(self, rank):

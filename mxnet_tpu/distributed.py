@@ -27,16 +27,32 @@ transports behind one ``put/get/scan/delete`` surface:
   rename writes.  Survives any member's death, needs no coordinator,
   and is what the hermetic single-host gangs (tools/launch.py local
   launcher, the multi-process tests) use.
+- :class:`TcpKV` — a real network transport (PR 12): length-prefixed
+  CRC'd frames to a small stdlib-only daemon (:class:`GangKVServer`,
+  embedded in tools/launch.py, standalone as tools/gang_kv.py).  Adds
+  leases (keys a client stops renewing expire) and watches (blocking
+  long-poll on a key prefix), and survives coordinator death by
+  deterministic failover: every client keeps a standby socket plus a
+  periodically refreshed state frame; the lowest-ranked live client
+  re-binds and replays, everyone else reconnects with decorrelated
+  jitter and resumes its leases.  No shared filesystem anywhere.
 - :class:`CoordKV` — the jax coordination-service key-value store (the
   same gRPC plane `barrier` uses), for real multi-host pods.
 
-`gang_kv()` picks the transport.
+`gang_kv()` picks the transport (``MXTPU_GANG_KV=file|tcp``,
+``MXTPU_GANG_ADDR``, ``MXTPU_GANG_DIR``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import zlib
 
 from . import resilience
 
@@ -300,10 +316,668 @@ class CoordKV:
             return default
 
 
+# ---------------------------------------------------------------------------
+# TcpKV: the coordination-service KV over real TCP (PR 12).
+#
+# Framing is the PR 8 buddy-snapshot idiom (checkpoint.PeerSnapshotStore's
+# MXTPSNP1 frames): magic + fixed struct header + CRC32 + pickled payload,
+# so a torn or corrupted frame is a clean error, never a mis-parse.
+
+
+_KV_MAGIC = b"MXTPGKV1"
+_KV_HDR = struct.Struct("<BIQ")   # code u8 | crc32 u32 | payload_len u64
+_KV_MAX_FRAME = 64 << 20          # control-plane values are small
+
+(_OP_PUT, _OP_GET, _OP_SCAN, _OP_DEL, _OP_RENEW, _OP_WATCH,
+ _OP_STATE, _OP_PING) = range(1, 9)
+_ST_OK, _ST_ERR = 0, 1
+
+_OP_NAMES = {_OP_PUT: "put", _OP_GET: "get", _OP_SCAN: "scan",
+             _OP_DEL: "delete", _OP_RENEW: "renew", _OP_WATCH: "watch",
+             _OP_STATE: "state", _OP_PING: "ping"}
+
+
+class GangKVError(resilience.MXNetError):
+    """The TCP gang KV could not complete an operation (after retries
+    and failover attempts) — or a `net_partition` fault is armed for
+    this rank."""
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("gang kv: peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _kv_send(conn, code, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    hdr = _KV_HDR.pack(code, zlib.crc32(payload) & 0xFFFFFFFF,
+                       len(payload))
+    conn.sendall(_KV_MAGIC + hdr + payload)
+
+
+def _kv_recv(conn):
+    raw = _recv_exact(conn, len(_KV_MAGIC) + _KV_HDR.size)
+    if raw[:len(_KV_MAGIC)] != _KV_MAGIC:
+        raise ConnectionError("gang kv: bad frame magic")
+    code, crc, length = _KV_HDR.unpack(raw[len(_KV_MAGIC):])
+    if length > _KV_MAX_FRAME:
+        raise ConnectionError(f"gang kv: oversized frame ({length} B)")
+    payload = _recv_exact(conn, length)
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise ConnectionError("gang kv: frame CRC mismatch")
+    return code, pickle.loads(payload)
+
+
+def _check_kv_key(key):
+    if ".." in key.split("/"):
+        raise ValueError(f"bad kv key: {key!r}")
+    return key
+
+
+def lease_ttl_from_env(default=10.0):
+    try:
+        return max(0.1, float(os.environ.get("MXTPU_LEASE_TTL", default)))
+    except ValueError:
+        return default
+
+
+class GangKVServer:
+    """Stdlib-only gang KV daemon: a dict + leases + watch conditions
+    behind the framed TCP protocol above.
+
+    - keys → bytes, exactly the FileKV namespace; ``scan`` is
+      non-recursive (direct children only), sorted.
+    - leases: a PUT may carry a lease id; a sweeper deletes every key of
+      a lease whose client stopped renewing for ``lease_ttl`` — the
+      heartbeat files' mtime-freshness, without a filesystem.
+    - watches: every mutation bumps a global version and notifies; a
+      WATCH long-polls until some key under its prefix changes past the
+      version the client last saw.
+    - failover seeding: ``state=``/``version=`` restart the store from a
+      client's cached STATE frame (the promoted coordinator's replay);
+      ``sock=`` serves on a pre-bound standby socket.
+
+    The ``kill_coordinator`` fault site makes the daemon drop dead on
+    the next mutation — mid-protocol, connections cut, no reply — which
+    is exactly what the client failover path must survive.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, *, lease_ttl=None,
+                 state=None, version=0, leases=None, sock=None):
+        self.lease_ttl = (lease_ttl_from_env() if lease_ttl is None
+                          else float(lease_ttl))
+        self._data = {}
+        for k, v in (state or {}).items():
+            self._data[k] = v if isinstance(v, bytes) else \
+                str(v).encode("utf-8")
+        self._ver = int(version)
+        self._key_ver = {k: self._ver for k in self._data}
+        now = time.monotonic()
+        self._leases = {}
+        for lid, keys in (leases or {}).items():
+            self._leases[lid] = {"deadline": now + self.lease_ttl,
+                                 "keys": set(keys)}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._conns = set()
+        self._threads = []
+        self.requests = 0
+        self.died = False           # killed by fault injection
+        if sock is not None:
+            self._sock = sock
+        else:
+            self._sock = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, int(port)))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._accept_thread = None
+
+    @property
+    def addr(self):
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        if self._accept_thread is None:
+            self._sock.listen(64)
+            self._sock.settimeout(0.2)
+            self._accept_thread = threading.Thread(
+                target=self._serve, name=f"gang-kv:{self.port}",
+                daemon=True)
+            self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+
+    # alias: a killed coordinator and a stopped one look the same to
+    # clients; tests use die() to simulate coordinator death in-process
+    def die(self):
+        self.died = True
+        self.stop()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            self._sweep_leases()
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(60.0)
+            self._conns.add(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _sweep_leases(self):
+        now = time.monotonic()
+        with self._cond:
+            expired = [lid for lid, l in self._leases.items()
+                       if l["deadline"] < now]
+            changed = False
+            for lid in expired:
+                for k in self._leases.pop(lid)["keys"]:
+                    if k in self._data:
+                        del self._data[k]
+                        self._ver += 1
+                        self._key_ver[k] = self._ver
+                        changed = True
+            if changed:
+                self._cond.notify_all()
+
+    def _handle(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    code, args = _kv_recv(conn)
+                except (ConnectionError, OSError, EOFError,
+                        pickle.UnpicklingError):
+                    return
+                self.requests += 1
+                if code in (_OP_PUT, _OP_DEL) and \
+                        resilience.consume_fault("kill_coordinator") and \
+                        not resilience.fault_armed("kill_coordinator"):
+                    # the consumed charge was the last: this is the Nth
+                    # mutation of a kill_coordinator:N plan
+                    # injected coordinator death: cut every client off
+                    # mid-request, no reply — the worst-timed crash
+                    self.die()
+                    return
+                try:
+                    resp = self._dispatch(code, args)
+                except ValueError as e:
+                    _kv_send(conn, _ST_ERR, f"{e}")
+                    continue
+                try:
+                    _kv_send(conn, _ST_OK, resp)
+                except OSError:
+                    return
+        finally:
+            self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, code, args):
+        if code == _OP_PUT:
+            key, value, lease_id = args
+            _check_kv_key(key)
+            with self._cond:
+                self._data[key] = value
+                self._ver += 1
+                self._key_ver[key] = self._ver
+                if lease_id:
+                    lease = self._leases.setdefault(
+                        lease_id, {"deadline": 0.0, "keys": set()})
+                    lease["keys"].add(key)
+                    lease["deadline"] = time.monotonic() + self.lease_ttl
+                self._cond.notify_all()
+                return self._ver
+        if code == _OP_GET:
+            with self._cond:
+                return self._data.get(args[0])
+        if code == _OP_SCAN:
+            pref = args[0].rstrip("/") + "/"
+            with self._cond:
+                return [(k, self._data[k]) for k in sorted(self._data)
+                        if k.startswith(pref)
+                        and "/" not in k[len(pref):]]
+        if code == _OP_DEL:
+            key = args[0]
+            with self._cond:
+                if key in self._data:
+                    del self._data[key]
+                    self._ver += 1
+                    self._key_ver[key] = self._ver
+                    self._cond.notify_all()
+                for lease in self._leases.values():
+                    lease["keys"].discard(key)
+                return self._ver
+        if code == _OP_RENEW:
+            lease_id, keys = args
+            with self._cond:
+                lease = self._leases.setdefault(
+                    lease_id, {"deadline": 0.0, "keys": set()})
+                lease["deadline"] = time.monotonic() + self.lease_ttl
+                lease["keys"] |= {k for k in keys if k in self._data}
+                return self._ver
+        if code == _OP_WATCH:
+            prefix, since, timeout = args
+            deadline = time.monotonic() + min(float(timeout), 30.0)
+            with self._cond:
+                start = self._ver if since is None else int(since)
+                while not self._stop.is_set():
+                    if any(v > start for k, v in self._key_ver.items()
+                           if k.startswith(prefix)):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(min(left, 0.5))
+                return self._ver
+        if code == _OP_STATE:
+            with self._cond:
+                return (self._ver, dict(self._data),
+                        {lid: sorted(l["keys"])
+                         for lid, l in self._leases.items()})
+        if code == _OP_PING:
+            return self._ver
+        raise ValueError(f"gang kv: unknown op {code}")
+
+
+class TcpKV:
+    """FileKV-compatible client for :class:`GangKVServer`.
+
+    Same ``put/get/scan/delete`` + JSON surface, plus:
+
+    - leases: keys under ``ephemeral_prefixes`` (heartbeats, failover
+      candidacy) are attached to this client's lease and renewed by a
+      background thread; when the process dies the server expires them
+      — the replacement for heartbeat-file mtime freshness.
+    - ``watch(prefix)``: blocking long-poll until a key under the
+      prefix changes — the replacement for directory rescans.
+    - coordinator failover: the client keeps (a) a standby socket bound
+      at construction and advertised at ``failover/<rank>``, (b) a state
+      frame refreshed on every lease renewal, and (c) an LRU of its own
+      recent writes.  When the coordinator dies, each retry pings the
+      standby addresses of lower-ranked clients; the lowest live rank
+      promotes itself (re-binds, replays the state frame), everyone
+      else adopts the promoted address and replays its own writes —
+      which is also what re-proposes an interrupted epoch proposal
+      (epoch/current is one of the proposer's recent writes).
+    """
+
+    _REPLAY_KEYS = 256   # per-client write LRU replayed after failover
+
+    def __init__(self, addr=None, *, rank=None, lease_ttl=None,
+                 ephemeral_prefixes=("hb/", "failover/"), standby=None,
+                 timeout=None):
+        addr = addr or os.environ.get("MXTPU_GANG_ADDR")
+        if not addr:
+            raise resilience.MXNetError(
+                "TcpKV needs an address (MXTPU_GANG_ADDR=host:port)")
+        host, _, port = addr.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        if rank is None:
+            r = os.environ.get("MXTPU_WORKER_RANK")
+            rank = int(r) if r is not None else None
+        self.rank = rank
+        self._timeout = float(
+            os.environ.get("MXTPU_KV_TIMEOUT", 5.0)
+            if timeout is None else timeout)
+        self._ttl = (lease_ttl_from_env() if lease_ttl is None
+                     else float(lease_ttl))
+        self._eph = tuple(ephemeral_prefixes)
+        self._lease_id = (f"r{rank if rank is not None else 'x'}."
+                          f"{os.getpid()}.{os.urandom(3).hex()}")
+        self._stagger = float(
+            os.environ.get("MXTPU_KV_FAILOVER_STAGGER", 0.5))
+        self._retries = int(os.environ.get("MXTPU_KV_RETRIES", 10))
+        self._conn = None
+        self._conn_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._state = ({}, 0)        # (data, version) — failover seed
+        self._written = {}           # key -> value LRU (failover replay)
+        self._leased = set()
+        self._down_since = None
+        self._fo_lock = threading.Lock()
+        self._server = None          # set if this client promoted
+        self.failovers = 0
+        self.closed = False
+        self._standby = None
+        if standby is None:
+            standby = rank is not None
+        if standby:
+            self._standby = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+            self._standby.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+            # bound but NOT listening: pings get ECONNREFUSED until the
+            # promotion actually happens
+            self._standby.bind((self._host if self._host != "0.0.0.0"
+                                else "127.0.0.1", 0))
+        self._stop = threading.Event()
+        self._renewer = threading.Thread(
+            target=self._renew_loop, name=f"gang-kv-lease:{rank}",
+            daemon=True)
+        self._renewer.start()
+        if self._standby is not None:
+            sh, sp = self._standby.getsockname()[:2]
+            try:
+                self.put_json(f"failover/{self.rank}",
+                              {"rank": self.rank, "host": sh,
+                               "port": sp})
+            except Exception:   # noqa: BLE001 — registered on reconnect
+                pass
+        try:
+            self._refresh_state()
+        except Exception:       # noqa: BLE001 — refreshed by renewals
+            pass
+
+    # -- transport -------------------------------------------------------------
+
+    def _connect(self):
+        conn = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def _rpc(self, op, args, timeout=None):
+        with self._conn_lock:
+            try:
+                if self._conn is None:
+                    self._conn = self._connect()
+                self._conn.settimeout(timeout or self._timeout)
+                _kv_send(self._conn, op, args)
+                code, obj = _kv_recv(self._conn)
+            except (OSError, EOFError, ConnectionError,
+                    pickle.UnpicklingError) as e:
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    self._conn = None
+                raise ConnectionError(f"gang kv rpc failed: {e}") from e
+        if code == _ST_ERR:
+            raise ValueError(str(obj))
+        self._down_since = None
+        return obj
+
+    def _call(self, op, *args, timeout=None):
+        if self.rank is not None and \
+                self.rank in resilience.fault_args("net_partition"):
+            raise GangKVError(
+                f"rank {self.rank}: injected net partition")
+
+        def attempt():
+            return self._rpc(op, args, timeout=timeout)
+
+        def on_retry(_attempt, _exc, _sleep):
+            self._maybe_failover()
+
+        try:
+            return resilience.retry_call(
+                attempt, retries=self._retries, backoff=0.05,
+                max_backoff=0.5, jitter=True,
+                retryable=(ConnectionError, OSError),
+                on_retry=on_retry,
+                description=f"gang kv {_OP_NAMES.get(op, op)}")
+        except (ConnectionError, OSError) as e:
+            raise GangKVError(f"gang kv unreachable at "
+                              f"{self._host}:{self._port}: {e}") from e
+
+    # -- failover --------------------------------------------------------------
+
+    def _refresh_state(self):
+        ver, data, leases = self._rpc(_OP_STATE, ())
+        with self._state_lock:
+            self._state = (data, ver)
+        return ver
+
+    def _candidates(self):
+        with self._state_lock:
+            data = dict(self._state[0])
+        cands = []
+        for key, raw in data.items():
+            if not key.startswith("failover/"):
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8"))
+                cands.append((int(rec["rank"]), rec["host"],
+                              int(rec["port"])))
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+        return sorted(cands)
+
+    def _maybe_failover(self):
+        now = time.monotonic()
+        if self._down_since is None:
+            self._down_since = now
+            return
+        with self._fo_lock:
+            if self._server is not None:
+                return      # already promoted; retries hit our server
+            cands = self._candidates()
+            for idx, (r, host, port) in enumerate(cands):
+                if r == self.rank:
+                    if now - self._down_since >= idx * self._stagger:
+                        self._promote()
+                    return
+                try:
+                    conn = socket.create_connection((host, port),
+                                                    timeout=0.25)
+                    try:
+                        _kv_send(conn, _OP_PING, ())
+                        _kv_recv(conn)
+                    finally:
+                        conn.close()
+                except (OSError, ConnectionError):
+                    continue
+                self._adopt(host, port)
+                return
+
+    def _promote(self):
+        """Become the coordinator: listen on the standby socket, replay
+        the last state frame, then replay our own recent writes."""
+        if self._standby is None:
+            return
+        with self._state_lock:
+            data, ver = dict(self._state[0]), self._state[1]
+        srv = GangKVServer(lease_ttl=self._ttl, state=data,
+                           version=ver + 1, sock=self._standby)
+        srv.start()
+        self._server = srv
+        self._standby = None
+        self._host, self._port = srv.host, srv.port
+        self._down_since = None
+        self.failovers += 1
+        resilience._tel_event("coordinator_failover", rank=self.rank,
+                              addr=srv.addr, role="promoted",
+                              replayed_keys=len(data))
+        self._replay_writes()
+
+    def _adopt(self, host, port):
+        self._host, self._port = host, port
+        self._down_since = None
+        self.failovers += 1
+        resilience._tel_event("coordinator_reconnect", rank=self.rank,
+                              addr=f"{host}:{port}")
+        self._replay_writes()
+
+    def _replay_writes(self):
+        """Re-put this client's recent writes against the (new)
+        coordinator: resumes our leases and re-proposes any epoch record
+        this rank was mid-writing when the old coordinator died."""
+        for key, value in list(self._written.items()):
+            lease = self._lease_id if self._is_ephemeral(key) else None
+            try:
+                self._rpc(_OP_PUT, (key, value, lease))
+            except (ConnectionError, OSError, ValueError):
+                return
+
+    # -- lease renewal ---------------------------------------------------------
+
+    def _renew_loop(self):
+        interval = max(0.05, min(self._ttl / 3.0, 2.0))
+        last_ver = -1
+        while not self._stop.wait(interval):
+            try:
+                ver = self._call(_OP_RENEW,
+                                 self._lease_id, sorted(self._leased))
+                if ver != last_ver:
+                    last_ver = self._refresh_state()
+            except Exception:   # noqa: BLE001 — next op retries/fails over
+                pass
+
+    def _is_ephemeral(self, key):
+        return any(key.startswith(p) for p in self._eph)
+
+    # -- the FileKV surface ----------------------------------------------------
+
+    def put(self, key, value):
+        _check_kv_key(key)
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        lease = None
+        if self._is_ephemeral(key):
+            lease = self._lease_id
+            self._leased.add(key)
+        self._written[key] = value
+        if len(self._written) > self._REPLAY_KEYS:
+            self._written.pop(next(iter(self._written)))
+        self._call(_OP_PUT, key, value, lease)
+
+    def get(self, key, default=None):
+        _check_kv_key(key)
+        value = self._call(_OP_GET, key)
+        return default if value is None else value
+
+    def scan(self, prefix):
+        return [(k, v) for k, v in self._call(_OP_SCAN, prefix)]
+
+    def delete(self, key):
+        self._written.pop(key, None)
+        self._leased.discard(key)
+        self._call(_OP_DEL, key)
+
+    def put_json(self, key, obj):
+        self.put(key, json.dumps(obj, sort_keys=True))
+
+    def get_json(self, key, default=None):
+        raw = self.get(key)
+        if raw is None:
+            return default
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return default
+
+    # -- extras over FileKV ----------------------------------------------------
+
+    def watch(self, prefix, since=None, timeout=1.0):
+        """Block until a key under ``prefix`` changes (or ``timeout``).
+        Returns the server's version counter — pass it back as
+        ``since`` to never miss a change between calls.  Best-effort:
+        returns ``since`` on transport failure (callers fall back to
+        their polling loop).  Uses a dedicated connection so a long
+        poll never blocks the pooled one (heartbeats keep flowing)."""
+        try:
+            conn = self._connect()
+            try:
+                conn.settimeout(timeout + self._timeout)
+                _kv_send(conn, _OP_WATCH, (prefix, since, timeout))
+                code, obj = _kv_recv(conn)
+            finally:
+                conn.close()
+            if code == _ST_ERR:
+                raise ValueError(str(obj))
+            return obj
+        except (ConnectionError, OSError):
+            self._maybe_failover()
+            return since
+
+    def ping(self):
+        return self._call(_OP_PING)
+
+    def close(self, stop_server=True):
+        self.closed = True
+        self._stop.set()
+        self._renewer.join(timeout=2.0)
+        with self._conn_lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+        if self._standby is not None:
+            try:
+                self._standby.close()
+            except OSError:
+                pass
+        if stop_server and self._server is not None:
+            self._server.stop()
+
+
+_TCP_KV_CACHE = {}
+
+
+def _tcp_gang_kv(addr):
+    """Per-process TcpKV singleton: one lease + one standby socket per
+    (address, rank), however many times gang_kv() is called."""
+    rank = os.environ.get("MXTPU_WORKER_RANK")
+    key = (addr, rank)
+    kv = _TCP_KV_CACHE.get(key)
+    if kv is None or kv.closed:
+        kv = TcpKV(addr)
+        _TCP_KV_CACHE[key] = kv
+    return kv
+
+
 def gang_kv():
     """The elastic control plane's KV transport, or None when elastic
-    recovery has nowhere to publish (no gang dir, not distributed)."""
+    recovery has nowhere to publish (no gang dir/addr, not
+    distributed).  Selection: ``MXTPU_GANG_KV=file|tcp`` explicitly;
+    otherwise ``MXTPU_GANG_ADDR`` ⇒ tcp, ``MXTPU_GANG_DIR`` ⇒ file
+    (dir wins when both are set and no explicit choice was made),
+    else the coordination-service KV."""
+    mode = (os.environ.get("MXTPU_GANG_KV") or "").strip().lower()
+    addr = os.environ.get("MXTPU_GANG_ADDR")
     root = os.environ.get("MXTPU_GANG_DIR")
+    if mode not in ("", "file", "tcp"):
+        raise resilience.MXNetError(
+            f"MXTPU_GANG_KV must be 'file' or 'tcp', got {mode!r}")
+    if mode == "tcp" or (not mode and addr and not root):
+        if not addr:
+            raise resilience.MXNetError(
+                "MXTPU_GANG_KV=tcp needs MXTPU_GANG_ADDR=host:port")
+        return _tcp_gang_kv(addr)
+    if mode == "file" and not root:
+        raise resilience.MXNetError(
+            "MXTPU_GANG_KV=file needs MXTPU_GANG_DIR")
     if root:
         return FileKV(root)
     client = _coordination_client()

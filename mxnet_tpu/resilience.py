@@ -136,7 +136,12 @@ class _FaultPlan:
             site, _, arg = item.partition(":")
             if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
                         "crash_during_save", "crash_before_manifest",
-                        "telemetry_crash", "corrupt_ckpt_write"):
+                        "telemetry_crash", "corrupt_ckpt_write",
+                        "kill_coordinator"):
+                # kill_coordinator: the gang KV daemon
+                # (distributed.GangKVServer) drops dead on the Nth
+                # mutation — mid-protocol, no reply, connections cut —
+                # exercising the TcpKV client failover path
                 # nan_grad: poison one gradient with NaN before health
                 # assessment (consumed by the Trainer's numerics guard);
                 # inf_loss: corrupt the loss seen by
@@ -150,10 +155,14 @@ class _FaultPlan:
                           "corrupt_shard"):
                 self.args[site] = int(arg) if arg else 0
                 self.counts[site] = 1
-            elif site in ("kill_rank", "slow_rank", "heartbeat_loss"):
+            elif site in ("kill_rank", "slow_rank", "heartbeat_loss",
+                          "net_partition"):
                 # rank-targeted sites: repeatable ("kill_rank:1,
                 # kill_rank:2"), persistent conditions (no counter) —
-                # each process checks its OWN gang rank against the list
+                # each process checks its OWN gang rank against the
+                # list.  net_partition:K cuts rank K's TcpKV client off
+                # from the coordinator (every op raises GangKVError)
+                # while the process keeps running
                 self.list_args.setdefault(site, []).append(
                     int(arg) if arg else 0)
             elif site in ("stall_collective", "stall"):
@@ -972,17 +981,22 @@ class RankFailure(MXNetError):
     respawned ranks asking to rejoin.  Raised by `ElasticGang.step_tick`
     (and gang barriers); the handler calls `ElasticGang.recover`."""
 
-    def __init__(self, dead, epoch, joiners=()):
+    def __init__(self, dead, epoch, joiners=(), planned=False,
+                 at_step=None):
         self.dead = sorted(dead)
         self.joiners = sorted(joiners)
         self.epoch = int(epoch)
-        what = []
-        if self.dead:
-            what.append(f"dead ranks {self.dead}")
+        self.at_step = at_step         # planned reshape's agreed step
+        self.planned = bool(planned)   # scheduled drain/admit, nobody
+        what = []                      # actually died — no detection
+        if self.dead:                  # window, zero lost steps
+            what.append(f"{'leaving' if planned else 'dead'} ranks "
+                        f"{self.dead}")
         if self.joiners:
             what.append(f"join requests {self.joiners}")
         super().__init__(
-            f"gang membership change at epoch {epoch}: "
+            f"gang membership change at epoch {epoch}"
+            f"{' (planned)' if planned else ''}: "
             f"{', '.join(what) or 'unknown'}")
 
 
@@ -1206,7 +1220,7 @@ class RecoveryInfo:
 
     def __init__(self, *, epoch, members, snap_step, source, dead,
                  joined, recovery_ms, shards=None, full_state=None,
-                 old_members=()):
+                 old_members=(), planned=False):
         self.epoch = int(epoch)
         self.members = list(members)
         self.snap_step = int(snap_step)
@@ -1217,6 +1231,7 @@ class RecoveryInfo:
         self.shards = shards            # {old_rank: shard state} (peer)
         self.full_state = full_state    # full pytree (disk)
         self.old_members = list(old_members)
+        self.planned = bool(planned)    # drain/admit, not a death
 
     @property
     def world(self):
@@ -1290,6 +1305,11 @@ class ElasticGang:
         self.reshape_timeout = float(
             os.environ.get("MXTPU_RESHAPE_TIMEOUT", 60.0)
             if reshape_timeout is None else reshape_timeout)
+        # steps of notice a planned reshape (drain/admit) gives the
+        # gang: every member must tick the agreed step AFTER the plan
+        # lands, so it must exceed the worst lockstep skew (1 step)
+        self.drain_margin = max(
+            2, int(os.environ.get("MXTPU_SCALE_MARGIN", 2)))
         self.hb = HeartbeatPublisher(kv, rank,
                                      interval=heartbeat_interval)
         self.detector = FailureDetector(kv, rank, self.members,
@@ -1366,6 +1386,18 @@ class ElasticGang:
             if state is not None:
                 self.snapshot(step, state)
         self.straggler.observe(step, collective_share)
+        plan = self._pending_reshape(step)
+        if plan is not None:
+            # planned reshape due NOW: snapshot at this exact step so
+            # the whole gang shares the restore point (zero lost
+            # steps), then reshape with no detection window
+            leavers, admits, at_step = plan
+            if state is None and state_fn is not None:
+                state = state_fn()
+            if state is not None and self._last_snap_step != step:
+                self.snapshot(step, state)
+            raise RankFailure(leavers, self.epoch, joiners=admits,
+                              planned=True, at_step=at_step)
         self._check_epoch()
         dead = self.detector.poll() & set(self.members)
         dead.discard(self.rank)
@@ -1374,7 +1406,7 @@ class ElasticGang:
         if self._is_proposer():
             joiners = self._pending_joiners()
             if joiners:
-                raise RankFailure((), self.epoch, joiners=joiners)
+                self._schedule_admit(step, joiners)
 
     def snapshot(self, step, state):
         """RAM-replicate this rank's shard of ``state``: hold our own
@@ -1391,6 +1423,23 @@ class ElasticGang:
              "steps": self.peers.held_steps(self.rank,
                                             epoch=self.epoch),
              "epoch": self.epoch})
+        # departed ranks' shards are freed HERE, not in recover():
+        # forgetting there races a slower survivor's fetch of the
+        # departed rank's shard from this rank's RAM.  Prune only once
+        # every current member has signalled end-of-assembly
+        # (epoch_done/<e>/<r>, written at the bottom of recover)
+        prune = getattr(self.peers, "prune_ranks", None)
+        held_ranks = getattr(self.peers, "held_ranks", None)
+        if prune is not None and held_ranks is not None and \
+                any(r not in self.members for r in held_ranks()):
+            done = set()
+            for key, _ in self.kv.scan(f"epoch_done/{self.epoch}"):
+                try:
+                    done.add(int(key.rsplit("/", 1)[1]))
+                except ValueError:
+                    pass
+            if set(self.members) <= done:
+                prune(self.members)
 
     def _check_epoch(self):
         cur = self.kv.get_json("epoch/current")
@@ -1412,6 +1461,69 @@ class ElasticGang:
             if r is not None and r not in self.members:
                 joiners.append(int(r))
         return sorted(set(joiners))
+
+    # -- planned reshape (drain / scheduled admit) -----------------------------
+
+    def plan_leave(self, at_step):
+        """Schedule this rank's planned departure at ``at_step`` (a
+        preemption drain).  Every member — including this rank — keeps
+        stepping normally until its own tick of ``at_step``, snapshots
+        there, and reshapes; the leaver is excluded from the new epoch
+        and exits via :class:`GangEvicted`.  No detection window, no
+        lost steps.  ``at_step`` must be at least ``drain_margin``
+        steps ahead."""
+        at = int(at_step)
+        self.kv.put_json(f"leave/{self.rank}",
+                         {"rank": self.rank, "at_step": at,
+                          "epoch": self.epoch, "t": time.time()})
+        _tel_event("gang_drain_scheduled", rank=self.rank, at_step=at,
+                   epoch=self.epoch)
+        return at
+
+    def _schedule_admit(self, step, joiners):
+        """Proposer only: schedule joiners for a planned admit a few
+        steps out instead of reshaping immediately — every member then
+        snapshots at the same agreed step, so admission loses no
+        steps."""
+        admit = self.kv.get_json("admit/plan")
+        if isinstance(admit, dict) and \
+                int(admit.get("epoch", -1)) == self.epoch:
+            return      # one pending admit at a time; next epoch
+        self.kv.put_json("admit/plan",
+                         {"epoch": self.epoch,
+                          "at_step": int(step) + self.drain_margin,
+                          "joiners": sorted(joiners),
+                          "t": time.time()})
+
+    def _pending_reshape(self, step):
+        """The planned membership change due at this tick, as
+        ``(leavers, joiners, at_step)`` — or None when nothing is due
+        yet.  Scheduled leaves and a scheduled admit that fall due
+        together reshape in one epoch."""
+        leavers, due_at = [], []
+        for key, _ in self.kv.scan("leave"):
+            rec = self.kv.get_json(key)
+            if not isinstance(rec, dict):
+                continue
+            r = rec.get("rank")
+            if r is None or int(r) not in self.members:
+                continue
+            at = int(rec.get("at_step", step))
+            if at <= step:
+                leavers.append(int(r))
+                due_at.append(at)
+        joiners = []
+        admit = self.kv.get_json("admit/plan")
+        if isinstance(admit, dict) and \
+                int(admit.get("epoch", -1)) == self.epoch and \
+                int(admit.get("at_step", step)) <= step:
+            joiners = [int(j) for j in admit.get("joiners", ())
+                       if int(j) not in self.members]
+            if joiners:
+                due_at.append(int(admit.get("at_step", step)))
+        if not leavers and not joiners:
+            return None
+        return sorted(set(leavers)), joiners, max(due_at)
 
     # -- gang barrier ----------------------------------------------------------
 
@@ -1456,8 +1568,12 @@ class ElasticGang:
         ck = checkpointer or self.checkpointer
         dead = set(failure.dead) if failure is not None else set()
         joiners = set(failure.joiners) if failure is not None else set()
+        planned = bool(getattr(failure, "planned", False))
+        target = getattr(failure, "at_step", None)
         old_members = list(self.members)
-        proposal = self._await_proposal(dead, joiners, ck)
+        proposal = self._await_proposal(dead, joiners, ck,
+                                        target_step=target,
+                                        planned=planned)
         epoch = int(proposal["epoch"])
         new_members = [int(r) for r in proposal["members"]]
         if self.rank not in new_members:
@@ -1501,6 +1617,7 @@ class ElasticGang:
                             else ck.latest_step())
             full_state = ck.restore(snap_step)
             _tel_count("elastic.disk_restores")
+        planned = bool(proposal.get("planned", planned))
         # adopt the new membership
         self.epoch = epoch
         self.members = new_members
@@ -1522,22 +1639,31 @@ class ElasticGang:
                 pass
         ms = (time.monotonic() - t0) * 1000.0
         for d in sorted(dead):
-            _tel_event("rank_dead", rank=d, epoch=epoch)
+            if planned:
+                _tel_event("rank_drained", rank=d, epoch=epoch)
+            else:
+                _tel_event("rank_dead", rank=d, epoch=epoch)
         for j in sorted(joined):
             _tel_event("rank_rejoin", rank=j, epoch=epoch)
         _tel_event("mesh_reshape", epoch=epoch, world=len(new_members),
-                   members=new_members, step=snap_step)
+                   members=new_members, step=snap_step, planned=planned)
         _tel_event("elastic_recover", epoch=epoch, step=snap_step,
-                   source=source, recovery_ms=round(ms, 2))
+                   source=source, recovery_ms=round(ms, 2),
+                   planned=planned)
         sys.stderr.write(
             f"[resilience] rank {self.rank}: gang reshaped to epoch "
-            f"{epoch} world {len(new_members)} (source={source}, "
+            f"{epoch} world {len(new_members)} "
+            f"({'planned, ' if planned else ''}source={source}, "
             f"snap_step={snap_step}, {ms:.0f} ms)\n")
+        # end-of-assembly marker: departed ranks' RAM shards may be
+        # pruned once every member has written this (see snapshot())
+        self.kv.put_json(f"epoch_done/{epoch}/{self.rank}",
+                         {"rank": self.rank, "t": time.time()})
         return RecoveryInfo(epoch=epoch, members=new_members,
                             snap_step=snap_step, source=source,
                             dead=dead, joined=joined, recovery_ms=ms,
                             shards=shards, full_state=full_state,
-                            old_members=old_members)
+                            old_members=old_members, planned=planned)
 
     def join(self, timeout=None):
         """A (re)spawned rank asks the running gang for admission.
@@ -1577,12 +1703,18 @@ class ElasticGang:
 
     # -- protocol internals ----------------------------------------------------
 
-    def _await_proposal(self, dead, joiners, ck):
+    def _await_proposal(self, dead, joiners, ck, target_step=None,
+                        planned=False):
         """Wait for (or, as the lowest-ranked survivor, write) the next
         epoch proposal.  Proposer promotion is implicit: if the lowest
         survivor dies before proposing, the detector adds it to ``dead``
-        and the next-lowest takes over."""
+        and the next-lowest takes over.  A planned reshape carries a
+        ``target_step`` the proposal must be able to restore at (every
+        member snapshotted there); the target is dropped halfway to the
+        reshape timeout so a wedged drain degrades to lost steps rather
+        than a dead gang."""
         deadline = time.monotonic() + self.reshape_timeout
+        t_half = time.monotonic() + self.reshape_timeout / 2
         while True:
             cur = self.kv.get_json("epoch/current")
             if cur and int(cur.get("epoch", 0)) > self.epoch:
@@ -1593,10 +1725,15 @@ class ElasticGang:
             if joiners:
                 joiners = set(self._pending_joiners()) | set(joiners)
             if self._is_proposer(survivors):
+                want = target_step \
+                    if time.monotonic() < t_half else None
                 proposal = self._make_proposal(dead, joiners,
-                                               survivors, ck)
-                self.kv.put_json("epoch/current", proposal)
-                return proposal
+                                               survivors, ck,
+                                               target_step=want,
+                                               planned=planned)
+                if proposal is not None:
+                    self.kv.put_json("epoch/current", proposal)
+                    return proposal
             if time.monotonic() > deadline:
                 raise MXNetError(
                     f"rank {self.rank}: no epoch proposal within "
@@ -1604,7 +1741,8 @@ class ElasticGang:
                     f"{self.members}, dead {sorted(dead)})")
             time.sleep(0.05)
 
-    def _make_proposal(self, dead, joiners, survivors, ck):
+    def _make_proposal(self, dead, joiners, survivors, ck,
+                       target_step=None, planned=False):
         new_members = sorted(set(survivors) | set(joiners))
         # common RAM restore point: the newest step that EVERY survivor
         # still holds (each advertises its retained steps, not just the
@@ -1634,6 +1772,12 @@ class ElasticGang:
                 common &= set(int(s) for s in held.get("steps", []))
                 if not common:
                     break
+        if target_step is not None and \
+                not (common and max(common) >= int(target_step)):
+            # planned reshape: restore point must be the agreed drain
+            # step (zero lost steps) — a straggler's snapshot hasn't
+            # landed yet, so don't propose; loop and retry
+            return None
         ram_step = max(common) if common else None
         source = "peer" if ram_step is not None else "disk"
         disk_step = None
@@ -1645,11 +1789,15 @@ class ElasticGang:
                     "committed disk checkpoint to fall back to")
         for j in joiners:
             self.kv.delete(f"join_req/{j}")
+        for d in dead:
+            self.kv.delete(f"leave/{d}")
+        self.kv.delete("admit/plan")
         return {"epoch": self.epoch + 1, "members": new_members,
                 "old_members": list(self.members),
                 "dead": sorted(dead), "joined": sorted(joiners),
                 "snap_step": ram_step if source == "peer" else disk_step,
                 "disk_step": disk_step, "source": source,
+                "planned": bool(planned),
                 "proposer": self.rank, "t": time.time()}
 
     def _await_acks(self, epoch, new_members):
@@ -1725,6 +1873,140 @@ class ElasticGang:
                 return None
             shards[o] = st
         return shards
+
+
+# -- autoscaling policy loop ---------------------------------------------------
+
+class ScalePolicy:
+    """Chooses the gang's world size from live telemetry.
+
+    Grow: when the input pipeline is saturated — prefetch queue depth
+    (telemetry gauge ``input.queue_depth``) at/above ``queue_high`` for
+    ``window`` consecutive observations while the data-wait share stays
+    at/below ``stall_low`` (compute-bound: more chips raise
+    throughput) — write a ``scale/req`` record.  The launcher polls it
+    and spawns extra ranks, which enter through the existing
+    ``join_req`` path as a *scheduled* admit (zero lost steps).
+
+    Shrink: ``on_preemption`` turns a preemption notice into a graceful
+    drain — ``gang.plan_leave`` schedules this rank's departure a
+    ``drain_margin`` of steps out, every member snapshots at the agreed
+    step, and the reshape happens with no detection window.  The freed
+    chips are announced (:func:`announce_freed_chips`) for the serving
+    tier to claim.
+
+    Knobs (ctor arg beats env beats default): ``MXTPU_SCALE_QUEUE_HIGH``
+    (2.0), ``MXTPU_SCALE_STALL_LOW`` (0.1), ``MXTPU_SCALE_WINDOW`` (5),
+    ``MXTPU_SCALE_COOLDOWN`` (30 s), ``MXTPU_SCALE_MAX_WORLD``,
+    ``MXTPU_SCALE_MIN_WORLD`` (1).  The loop only runs when
+    ``MXTPU_SCALE_POLICY`` is set (see :meth:`enabled`).
+    """
+
+    def __init__(self, gang, *, min_world=None, max_world=None,
+                 queue_high=None, stall_low=None, window=None,
+                 cooldown=None):
+        def _env(name, default, cast=float):
+            v = os.environ.get(name)
+            return default if v in (None, "") else cast(v)
+
+        self.gang = gang
+        self.min_world = int(_env("MXTPU_SCALE_MIN_WORLD", 1, int)
+                             if min_world is None else min_world)
+        self.max_world = (_env("MXTPU_SCALE_MAX_WORLD", None,
+                               lambda v: int(v))
+                          if max_world is None else max_world)
+        self.queue_high = float(_env("MXTPU_SCALE_QUEUE_HIGH", 2.0)
+                                if queue_high is None else queue_high)
+        self.stall_low = float(_env("MXTPU_SCALE_STALL_LOW", 0.1)
+                               if stall_low is None else stall_low)
+        self.window = max(1, int(_env("MXTPU_SCALE_WINDOW", 5, int)
+                                 if window is None else window))
+        self.cooldown = float(_env("MXTPU_SCALE_COOLDOWN", 30.0)
+                              if cooldown is None else cooldown)
+        self._hot = 0               # consecutive saturated observations
+        self._last_req = 0.0        # monotonic time of last scale/req
+        self.grow_requests = 0
+        self.drains = 0
+
+    @staticmethod
+    def enabled():
+        """MXTPU_SCALE_POLICY gates the whole loop (off by default)."""
+        return os.environ.get("MXTPU_SCALE_POLICY", "").lower() \
+            in ("1", "on", "true", "auto")
+
+    def _queue_depth(self):
+        try:
+            from . import telemetry
+        except ImportError:
+            return None
+        return telemetry.REGISTRY.gauge("input.queue_depth").value
+
+    def observe(self, step, queue_depth=None, data_share=None):
+        """Feed one step's signals; returns ``"grow"`` when a scale-up
+        request was just published, else None.  ``queue_depth`` defaults
+        to the live ``input.queue_depth`` gauge."""
+        if queue_depth is None:
+            queue_depth = self._queue_depth()
+        if queue_depth is None:
+            return None
+        saturated = queue_depth >= self.queue_high and \
+            (data_share is None or data_share <= self.stall_low)
+        self._hot = self._hot + 1 if saturated else 0
+        if self._hot < self.window:
+            return None
+        now = time.monotonic()
+        if now - self._last_req < self.cooldown:
+            return None
+        world = len(self.gang.members)
+        want = world + 1
+        if self.max_world is not None and want > int(self.max_world):
+            return None
+        req = self.gang.kv.get_json("scale/req")
+        if isinstance(req, dict) and int(req.get("want_world", 0)) \
+                >= want:
+            return None     # an equal-or-larger request is pending
+        self.gang.kv.put_json(
+            "scale/req", {"want_world": want, "step": int(step),
+                          "reason": "input_saturated",
+                          "queue_depth": float(queue_depth),
+                          "t": time.time()})
+        _tel_event("scale_up", rank=self.gang.rank, step=int(step),
+                   want_world=want, world=world,
+                   queue_depth=float(queue_depth))
+        self._last_req = now
+        self._hot = 0
+        self.grow_requests += 1
+        return "grow"
+
+    def on_preemption(self, step):
+        """Preemption notice → graceful drain: schedule this rank's
+        planned departure and announce the chips it frees.  Returns the
+        agreed departure step, or None when the gang is already at
+        ``min_world``."""
+        if len(self.gang.members) <= self.min_world:
+            return None
+        at = self.gang.plan_leave(int(step) + self.gang.drain_margin)
+        _tel_event("scale_down", rank=self.gang.rank, step=int(step),
+                   at_step=at, world=len(self.gang.members),
+                   planned=True)
+        self.drains += 1
+        return at
+
+
+def announce_freed_chips(kv, rank, *, step=None, count=1, addr=None):
+    """Publish that ``rank``'s chips are free (post-drain): the serving
+    tier's FleetWatcher claims ``chips/freed/<rank>`` and spawns a
+    replica on them — one elastically partitioned mesh shared by
+    training and serving."""
+    rec = {"rank": int(rank), "count": int(count), "t": time.time()}
+    if step is not None:
+        rec["step"] = int(step)
+    if addr is not None:
+        rec["addr"] = addr
+    kv.put_json(f"chips/freed/{rank}", rec)
+    _tel_event("chips_freed", rank=int(rank), count=int(count),
+               step=step)
+    return rec
 
 
 def _tel_count(name, n=1):

@@ -26,6 +26,16 @@ import time
 from concurrent.futures import Future
 
 from .. import telemetry
+from ..base import MXNetError, getenv_int
+
+
+class ServerOverloaded(MXNetError):
+    """submit() on a full admission queue: the request is shed
+    immediately instead of growing tail latency unboundedly."""
+
+
+class DeadlineExceeded(MXNetError):
+    """The request's deadline passed before it reached the engine."""
 
 
 def max_delay_ms_from_env(default=5.0):
@@ -38,14 +48,24 @@ def max_delay_ms_from_env(default=5.0):
         return default
 
 
-class _Request:
-    __slots__ = ("prompt", "max_new_tokens", "future", "t_enqueue")
+def max_queue_from_env(default=256):
+    return max(1, getenv_int("MXTPU_SERVE_MAX_QUEUE", default))
 
-    def __init__(self, prompt, max_new_tokens):
+
+_SHUTDOWN = object()    # close() sentinel: wakes the blocked collector
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new_tokens", "future", "t_enqueue",
+                 "deadline")
+
+    def __init__(self, prompt, max_new_tokens, deadline_ms=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.future = Future()
         self.t_enqueue = time.perf_counter()
+        self.deadline = (None if deadline_ms is None
+                         else self.t_enqueue + float(deadline_ms) / 1e3)
 
 
 class ContinuousBatcher:
@@ -58,36 +78,56 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine, max_delay_ms=None, max_batch=None,
-                 before_batch=None, temperature=None, rng=None):
+                 before_batch=None, temperature=None, rng=None,
+                 max_queue=None):
         self.engine = engine
         self.max_delay_ms = (max_delay_ms_from_env()
                              if max_delay_ms is None else max_delay_ms)
         self.max_batch = max_batch or max(engine.batch_buckets)
+        self.max_queue = (max_queue_from_env()
+                          if max_queue is None else max(1, int(max_queue)))
         self.before_batch = before_batch
         self._temperature = temperature
         self._rng = rng
-        self._q = queue.Queue()
+        self._q = queue.Queue(maxsize=self.max_queue)
         self._stop = threading.Event()
         self.groups_served = 0
         self.requests_served = 0
+        self.shed = 0
+        self.deadline_exceeded = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="mxtpu-batcher", daemon=True)
         self._thread.start()
 
-    def submit(self, prompt, max_new_tokens=16):
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+        """Enqueue one request → Future.  Raises
+        :class:`ServerOverloaded` when the admission queue is full (the
+        caller — or its FrontDoor — decides whether to retry elsewhere);
+        a ``deadline_ms`` budget resolves the future with
+        :class:`DeadlineExceeded` if group formation can't reach it in
+        time."""
         if self._stop.is_set():
             raise RuntimeError("batcher is closed")
-        req = _Request(prompt, max_new_tokens)
-        self._q.put(req)
+        req = _Request(prompt, max_new_tokens, deadline_ms)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.shed += 1
+            telemetry.count("serving.queue_full")
+            telemetry.event("queue_full", depth=self.max_queue)
+            raise ServerOverloaded(
+                f"serving queue full ({self.max_queue} pending); "
+                f"request shed") from None
         return req.future
 
     def _collect(self):
         """Block for the first request, then coalesce until the deadline
-        or the largest bucket fills."""
-        try:
-            first = self._q.get(timeout=0.05)
-        except queue.Empty:
-            return []
+        or the largest bucket fills.  Blocking (not polling): an idle
+        replica costs zero CPU; close() wakes the block with a
+        sentinel — _collect returns None and the loop exits to drain."""
+        first = self._q.get()
+        if first is _SHUTDOWN:
+            return None
         group = [first]
         deadline = first.t_enqueue + self.max_delay_ms / 1e3
         while len(group) < self.max_batch:
@@ -96,18 +136,49 @@ class ContinuousBatcher:
                 # deadline hit — grab whatever is already queued, no wait
                 try:
                     while len(group) < self.max_batch:
-                        group.append(self._q.get_nowait())
+                        item = self._q.get_nowait()
+                        if item is _SHUTDOWN:
+                            break       # _loop re-checks _stop next
+                        group.append(item)
                 except queue.Empty:
                     pass
                 break
             try:
-                group.append(self._q.get(timeout=wait))
+                item = self._q.get(timeout=wait)
             except queue.Empty:
                 break
+            if item is _SHUTDOWN:
+                break
+            group.append(item)
         return group
+
+    def _expire(self, group, now):
+        """Resolve requests whose deadline passed during queueing with
+        DeadlineExceeded BEFORE they cost a dispatch slot; returns the
+        still-live remainder."""
+        live = []
+        for r in group:
+            if r.deadline is None or now <= r.deadline:
+                live.append(r)
+                continue
+            self.deadline_exceeded += 1
+            telemetry.count("serving.deadline_exceeded")
+            telemetry.request_record(
+                queue_us=(now - r.t_enqueue) * 1e6,
+                prefill_us=0.0, decode_us_per_token=0.0,
+                bucket=[1, 1], padded_fraction=0.0, new_tokens=0,
+                deadline_exceeded=True)
+            if not r.future.cancelled():
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed after "
+                    f"{(now - r.t_enqueue) * 1e3:.1f} ms in queue"))
+        return live
 
     def _serve(self, group):
         t_batch = time.perf_counter()
+        group = self._expire(group, t_batch)
+        if not group:
+            return
         try:
             if self.before_batch is not None:
                 self.before_batch()
@@ -134,13 +205,16 @@ class ContinuousBatcher:
                 bucket=timings["bucket"],
                 padded_fraction=timings["padded_fraction"],
                 new_tokens=len(toks),
-                generation=timings["generation"])
+                generation=timings["generation"],
+                deadline_exceeded=False)
             if not r.future.cancelled():
                 r.future.set_result(rec)
 
     def _loop(self):
         while not self._stop.is_set():
             group = self._collect()
+            if group is None:
+                break
             if group:
                 self._serve(group)
         # drain: resolve what is left rather than abandoning futures
@@ -149,9 +223,20 @@ class ContinuousBatcher:
                 group = [self._q.get_nowait()]
             except queue.Empty:
                 break
-            self._serve(group)
+            if group[0] is not _SHUTDOWN:
+                self._serve(group)
 
     def close(self, timeout=30.0):
         """Stop the loop; queued requests are still served (drained)."""
         self._stop.set()
+        # wake the blocked collector; the loop is consuming, so a full
+        # queue clears within the timeout
+        deadline = time.perf_counter() + timeout
+        while self._thread.is_alive():
+            try:
+                self._q.put(_SHUTDOWN, timeout=0.1)
+                break
+            except queue.Full:
+                if time.perf_counter() > deadline:
+                    break
         self._thread.join(timeout)

@@ -24,7 +24,7 @@ import time
 
 from .. import telemetry
 from ..base import MXNetError, getenv_int
-from .batcher import ContinuousBatcher
+from .batcher import ContinuousBatcher, ServerOverloaded
 
 
 def reload_poll_ms_from_env(default=200):
@@ -70,8 +70,9 @@ class ReplicaServer:
                 daemon=True)
             self._poller.start()
 
-    def submit(self, prompt, max_new_tokens=16):
-        return self.batcher.submit(prompt, max_new_tokens)
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+        return self.batcher.submit(prompt, max_new_tokens,
+                                   deadline_ms=deadline_ms)
 
     # -- hot reload ------------------------------------------------------------
 
@@ -155,8 +156,13 @@ class FrontDoor:
             dead |= set(self._detector.poll())
         return [r for r in self.replicas if r.rank not in dead]
 
-    def submit(self, prompt, max_new_tokens=16):
-        """Submit to the next live replica; fail over on submit error."""
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+        """Submit to the next live replica; fail over on submit error.
+
+        A :class:`ServerOverloaded` shed is NOT a replica failure — the
+        replica is healthy, just full — so it is retried once on the
+        next replica without marking anyone out, then re-raised for the
+        client to back off."""
         live = self.alive()
         if not live:
             raise MXNetError("FrontDoor: no live replicas")
@@ -164,15 +170,25 @@ class FrontDoor:
             start = self._rr
             self._rr += 1
         last_exc = None
+        shed = 0
         for i in range(len(live)):
             r = live[(start + i) % len(live)]
             try:
-                return r.submit(prompt, max_new_tokens)
+                return r.submit(prompt, max_new_tokens,
+                                deadline_ms=deadline_ms)
+            except ServerOverloaded as exc:
+                last_exc = exc
+                shed += 1
+                telemetry.event("serving_request_shed", rank=r.rank)
+                if shed > 1:        # one retry on the next replica
+                    break
             except Exception as exc:
                 last_exc = exc
                 self._failed.add(r.rank)
                 telemetry.event("serving_replica_failover", rank=r.rank,
                                 error=f"{type(exc).__name__}: {exc}")
+        if isinstance(last_exc, ServerOverloaded):
+            raise last_exc
         raise MXNetError(
             f"FrontDoor: every replica refused the request "
             f"(last: {last_exc})")
@@ -180,6 +196,75 @@ class FrontDoor:
     def close(self, timeout=30.0):
         for r in self.replicas:
             r.close(timeout)
+
+
+class FleetWatcher:
+    """Turns freed training chips into serving capacity.
+
+    Watches the gang KV for ``chips/freed/<rank>`` announcements
+    (written by ``resilience.announce_freed_chips`` after a ScalePolicy
+    drain), claims each one — delete the announcement, record
+    ``chips/claimed/<rank>`` — and calls ``spawn(announcement)`` to
+    bring up a replica on the freed chips.  ``spawn`` returns the
+    replica object (kept in ``self.replicas``) or None to decline.
+
+    One watcher per fleet: the claim is delete-based, so concurrent
+    watchers could double-claim — run it next to the FrontDoor.
+    """
+
+    def __init__(self, kv, spawn, poll_s=0.5):
+        self.kv = kv
+        self.spawn = spawn
+        self.poll_s = float(poll_s)
+        self.replicas = []
+        self.claimed = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def poll_once(self):
+        """Scan + claim + spawn; returns the replicas spawned now."""
+        spawned = []
+        for key, _ in self.kv.scan("chips/freed"):
+            rec = self.kv.get_json(key)
+            if not isinstance(rec, dict) or rec.get("rank") is None:
+                continue
+            rank = int(rec["rank"])
+            self.kv.delete(key)
+            self.kv.put_json(f"chips/claimed/{rank}",
+                             {"rank": rank, "t": time.time()})
+            self.claimed += 1
+            rep = self.spawn(rec)
+            telemetry.event("serving_replica_spawned", rank=rank,
+                            count=int(rec.get("count", 1)),
+                            spawned=rep is not None)
+            if rep is not None:
+                self.replicas.append(rep)
+                spawned.append(rep)
+        return spawned
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-fleet-watcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as exc:    # noqa: BLE001 — keep watching
+                telemetry.event("fleet_watcher_error",
+                                error=f"{type(exc).__name__}: {exc}")
+            self._stop.wait(self.poll_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 def _wait_all(futures, timeout=None):

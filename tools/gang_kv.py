@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Standalone gang coordination-service daemon (mxnet_tpu.distributed's
+GangKVServer behind a CLI).
+
+Runs the TCP control plane the elastic gang uses when there is no
+shared filesystem (``MXTPU_GANG_KV=tcp`` / ``MXTPU_GANG_ADDR``): the
+FileKV key namespace over length-prefixed CRC'd frames, plus leases and
+prefix watches.  tools/launch.py embeds the same server; this entry
+point is for running it on its own host (or under a supervisor).
+
+Prints ``LISTEN <host>:<port>`` on stdout once bound — launchers that
+asked for port 0 read the chosen port from there.
+
+Usage:
+    python tools/gang_kv.py [--addr HOST:PORT] [--lease-ttl SECONDS]
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def _import_distributed():
+    """Load mxnet_tpu.distributed without executing the package
+    __init__ (no jax on a coordinator host)."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "mxnet_tpu" not in sys.modules:
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = pkg
+    return importlib.import_module("mxnet_tpu.distributed")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="mxnet_tpu elastic-gang TCP KV daemon")
+    ap.add_argument("--addr", default=None,
+                    help="HOST:PORT to bind (default "
+                         "$MXTPU_GANG_ADDR or 127.0.0.1:0)")
+    ap.add_argument("--lease-ttl", type=float, default=None,
+                    help="lease TTL seconds (default $MXTPU_LEASE_TTL "
+                         "or 10)")
+    args = ap.parse_args(argv)
+
+    dist = _import_distributed()
+    addr = args.addr or os.environ.get("MXTPU_GANG_ADDR", "127.0.0.1:0")
+    host, _, port = addr.rpartition(":")
+    srv = dist.GangKVServer(host or "127.0.0.1", int(port),
+                            lease_ttl=args.lease_ttl)
+    srv.start()
+    sys.stdout.write(f"LISTEN {srv.addr}\n")
+    sys.stdout.flush()
+
+    done = threading.Event()
+
+    def _term(_sig, _frm):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not done.is_set() and not srv._stop.is_set():
+        done.wait(0.5)
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

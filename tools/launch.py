@@ -130,14 +130,48 @@ def launch_local(args, cmd):
     return failed
 
 
+def _import_distributed():
+    """Load mxnet_tpu.distributed without the package __init__ (the
+    launcher host needs no jax)."""
+    import importlib
+    import types
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "mxnet_tpu" not in sys.modules:
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = pkg
+    return importlib.import_module("mxnet_tpu.distributed")
+
+
+def _start_kv_daemon(addr):
+    """Spawn the embedded gang-KV daemon (tools/gang_kv.py); returns
+    (proc, bound_addr) once it prints its LISTEN line."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "gang_kv.py")
+    proc = subprocess.Popen(
+        [sys.executable, script, "--addr", addr or "127.0.0.1:0"],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("LISTEN "):
+        proc.kill()
+        raise RuntimeError(f"gang KV daemon failed to start: {line!r}")
+    return proc, line.split()[1]
+
+
 def launch_elastic(args, cmd):
     """Elastic supervision: peer death shrinks the gang instead of
-    killing it; the launcher's job is only to (a) provision the shared
-    control-plane dir and (b) respawn dead ranks so the gang can grow
-    back.
+    killing it; the launcher's job is only to (a) provision the control
+    plane, (b) respawn dead ranks so the gang can grow back, and (c)
+    act on ScalePolicy grow requests.
 
-    - ``MXTPU_GANG_DIR`` (created if ``--gang-dir`` is not given) and
-      ``MXTPU_ELASTIC=1`` are exported to every worker.
+    - Control plane: ``--kv file`` (default) exports ``MXTPU_GANG_DIR``
+      (created if ``--gang-dir`` is not given); ``--kv tcp`` embeds the
+      tools/gang_kv.py daemon and exports ``MXTPU_GANG_KV=tcp`` +
+      ``MXTPU_GANG_ADDR`` — no shared filesystem.  The daemon is NOT
+      restarted if it dies: the ranks' deterministic coordinator
+      failover (distributed.TcpKV) is the recovery story.
+    - ``MXTPU_ELASTIC=1`` is exported to every worker.
     - A rank that exits 0 is COMPLETE (including a rank the gang evicted
       — GangEvicted exits cleanly); it is never respawned.
     - A rank that dies (nonzero / signal) while peers are still running
@@ -146,47 +180,100 @@ def launch_elastic(args, cmd):
       ``MXTPU_ELASTIC_RESPAWN_DELAY`` seconds (default 1.5x the
       heartbeat timeout) so the survivors commit the shrink epoch before
       the rejoin request lands.
+    - A ``scale/req`` record in the KV (resilience.ScalePolicy) spawns a
+      NEW rank id, which enters through the gang's join protocol.
     - The launcher fails (returns the exit code) only when a rank dies
       with NO surviving peers to absorb it, or a death exceeds the
       respawn budget and the remaining gang also fails.
     """
-    gang_dir = args.gang_dir or tempfile.mkdtemp(prefix="mxtpu_gang_")
-    extra = {"MXTPU_GANG_DIR": gang_dir, "MXTPU_ELASTIC": "1"}
+    kv_daemon = None
+    gang_dir = None
+    if args.kv == "tcp":
+        kv_daemon, addr = _start_kv_daemon(args.gang_addr)
+        extra = {"MXTPU_GANG_KV": "tcp", "MXTPU_GANG_ADDR": addr,
+                 "MXTPU_ELASTIC": "1"}
+        sys.stderr.write(f"[launch] elastic gang KV daemon at {addr} "
+                         f"(pid {kv_daemon.pid})\n")
+    else:
+        gang_dir = args.gang_dir or tempfile.mkdtemp(prefix="mxtpu_gang_")
+        extra = {"MXTPU_GANG_DIR": gang_dir, "MXTPU_ELASTIC": "1"}
+        sys.stderr.write(f"[launch] elastic gang dir: {gang_dir}\n")
     hb_timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", 5.0))
     delay = float(os.environ.get("MXTPU_ELASTIC_RESPAWN_DELAY",
                                  1.5 * hb_timeout))
-    sys.stderr.write(f"[launch] elastic gang dir: {gang_dir}\n")
+    kv_client = None
+    try:
+        dist = _import_distributed()
+        kv_client = (dist.FileKV(gang_dir) if gang_dir is not None
+                     else dist.TcpKV(addr))
+    except Exception as exc:            # noqa: BLE001 — scale polling
+        sys.stderr.write(f"[launch] no scale polling ({exc})\n")
     procs = {rank: _spawn_worker(cmd, rank, args.num_workers, args.port,
                                  extra)
              for rank in range(args.num_workers)}
+    next_rank = args.num_workers
     respawns = 0
     failed = 0
-    while procs:
-        time.sleep(0.2)
-        for rank, p in list(procs.items()):
-            code = p.poll()
-            if code is None:
-                continue
-            del procs[rank]
-            if code == 0:
-                continue                      # complete, not failed
-            if not procs:
-                # nobody left to absorb the death: a real job failure
-                sys.stderr.write(f"[launch] rank {rank} exited "
-                                 f"rc={code} with no survivors\n")
-                failed = failed or code
-                continue
-            sys.stderr.write(f"[launch] rank {rank} died rc={code}; "
-                             f"gang absorbs it "
-                             f"({len(procs)} survivors)\n")
-            if respawns < args.max_restarts:
-                respawns += 1
-                time.sleep(delay)             # let the shrink commit
-                sys.stderr.write(
-                    f"[launch] respawning rank {rank} "
-                    f"(respawn {respawns}/{args.max_restarts})\n")
-                procs[rank] = _spawn_worker(
-                    cmd, rank, args.num_workers, args.port, extra)
+    last_scale_poll = 0.0
+    try:
+        while procs:
+            time.sleep(0.2)
+            for rank, p in list(procs.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del procs[rank]
+                if code == 0:
+                    continue                  # complete, not failed
+                if not procs:
+                    # nobody left to absorb the death: a real failure
+                    sys.stderr.write(f"[launch] rank {rank} exited "
+                                     f"rc={code} with no survivors\n")
+                    failed = failed or code
+                    continue
+                sys.stderr.write(f"[launch] rank {rank} died rc={code}; "
+                                 f"gang absorbs it "
+                                 f"({len(procs)} survivors)\n")
+                if respawns < args.max_restarts:
+                    respawns += 1
+                    time.sleep(delay)         # let the shrink commit
+                    sys.stderr.write(
+                        f"[launch] respawning rank {rank} "
+                        f"(respawn {respawns}/{args.max_restarts})\n")
+                    procs[rank] = _spawn_worker(
+                        cmd, rank, args.num_workers, args.port, extra)
+            now = time.monotonic()
+            if kv_client is not None and procs \
+                    and now - last_scale_poll >= 1.0:
+                last_scale_poll = now
+                try:
+                    req = kv_client.get_json("scale/req")
+                    if isinstance(req, dict) and \
+                            int(req.get("want_world", 0)) > len(procs):
+                        kv_client.delete("scale/req")
+                        r = next_rank
+                        next_rank += 1
+                        sys.stderr.write(
+                            f"[launch] scale/req want_world="
+                            f"{req['want_world']}: spawning rank {r}\n")
+                        procs[r] = _spawn_worker(
+                            cmd, r, args.num_workers, args.port, extra)
+                except Exception:   # noqa: BLE001 — KV may be failing over
+                    pass
+    finally:
+        if kv_client is not None:
+            try:
+                close = getattr(kv_client, "close", None)
+                if close is not None:
+                    close()
+            except Exception:       # noqa: BLE001
+                pass
+        if kv_daemon is not None and kv_daemon.poll() is None:
+            kv_daemon.terminate()
+            try:
+                kv_daemon.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                kv_daemon.kill()
     return failed
 
 
@@ -232,6 +319,13 @@ def main(argv=None):
     parser.add_argument("--gang-dir", default=None,
                         help="shared control-plane dir for --elastic "
                              "(default: a fresh temp dir)")
+    parser.add_argument("--kv", choices=["file", "tcp"], default="file",
+                        help="--elastic control plane: 'file' shares "
+                             "--gang-dir; 'tcp' embeds the gang_kv.py "
+                             "daemon (no shared filesystem)")
+    parser.add_argument("--gang-addr", default=None,
+                        help="HOST:PORT for --kv tcp (default "
+                             "127.0.0.1:0 — a free port)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.command

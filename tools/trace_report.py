@@ -119,6 +119,7 @@ def report_run(run, records, out):
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
+        report_fleet(kinds, requests, out)
 
 
 def report_requests(requests, out):
@@ -200,6 +201,68 @@ def report_resilience(kinds, out):
     for e in kinds.get("inflight_save_dropped", ()):
         out.write(f"    inflight save dropped: step "
                   f"{e.get('step', '?')} ({e.get('reason', '?')})\n")
+
+
+def report_fleet(kinds, requests, out):
+    """Traffic-elastic fleet section: scale events, planned-vs-detected
+    reshape latency, coordinator failovers, and the serving admission
+    counters (shed / deadline-exceeded requests).  Prints nothing when
+    the run had no fleet activity."""
+    fleet_kinds = ("scale_up", "scale_down", "gang_drain_scheduled",
+                   "rank_drained", "chips_freed",
+                   "serving_replica_spawned", "coordinator_failover",
+                   "coordinator_reconnect", "queue_full",
+                   "serving_request_shed")
+    deadline = sum(1 for r in requests if r.get("deadline_exceeded"))
+    if not any(k in kinds for k in fleet_kinds) and not deadline:
+        return
+    out.write("  fleet:\n")
+    for e in kinds.get("scale_up", ()):
+        out.write(f"    scale up: rank {e.get('rank', '?')} requested "
+                  f"world {e.get('world', '?')} -> "
+                  f"{e.get('want_world', '?')} at step "
+                  f"{e.get('step', '?')} (queue depth "
+                  f"{_fmt(e.get('queue_depth'))})\n")
+    for e in kinds.get("scale_down", ()):
+        out.write(f"    scale down: rank {e.get('rank', '?')} drains at "
+                  f"step {e.get('at_step', '?')} (world "
+                  f"{e.get('world', '?')}, planned)\n")
+    for e in kinds.get("rank_drained", ()):
+        out.write(f"    drained: rank {e.get('rank', '?')} left cleanly "
+                  f"(epoch {e.get('epoch', '?')})\n")
+    for e in kinds.get("chips_freed", ()):
+        out.write(f"    chips freed: rank {e.get('rank', '?')} "
+                  f"({e.get('count', '?')} chip(s))\n")
+    for e in kinds.get("serving_replica_spawned", ()):
+        out.write(f"    replica spawned on freed chips of rank "
+                  f"{e.get('rank', '?')}\n")
+    recovers = kinds.get("elastic_recover", ())
+    planned = [e.get("recovery_ms") for e in recovers
+               if e.get("planned") and e.get("recovery_ms") is not None]
+    detected = [e.get("recovery_ms") for e in recovers
+                if not e.get("planned")
+                and e.get("recovery_ms") is not None]
+    if planned or detected:
+        def _stats(vals):
+            return (f"mean {sum(vals) / len(vals):.1f} ms over "
+                    f"{len(vals)}") if vals else "none"
+        out.write(f"    reshape latency: planned {_stats(planned)}  "
+                  f"detected {_stats(detected)}\n")
+    failovers = kinds.get("coordinator_failover", ())
+    if failovers:
+        by = [f"rank {e.get('rank', '?')}" for e in failovers]
+        out.write(f"    coordinator failovers: {len(failovers)} "
+                  f"(promoted: {', '.join(by)})\n")
+    reconnects = len(kinds.get("coordinator_reconnect", ()))
+    if reconnects:
+        out.write(f"    coordinator reconnects: {reconnects}\n")
+    shed_batcher = len(kinds.get("queue_full", ()))
+    shed_front = len(kinds.get("serving_request_shed", ()))
+    if shed_batcher or shed_front:
+        out.write(f"    shed requests: {shed_batcher} queue-full "
+                  f"(front door retried {shed_front})\n")
+    if deadline:
+        out.write(f"    deadline-exceeded requests: {deadline}\n")
 
 
 def validate_all(records):
