@@ -1,0 +1,220 @@
+"""Self-tuning performance layer: measure → search → persist → replay.
+
+The repo accumulated a real knob space (all-reduce bucket MB, FSDP min
+size, prefetch depth, shm slot MB, remat policy, optimizer group
+splitting, grad-accum) and telemetry built the objective function
+(StepStats wall time + MFU).  This package closes the loop:
+
+- `space`  — typed knob declarations with domains, layers, and the
+  numerics-safety flag (the search touches semantics-changing knobs
+  only behind ``MXTPU_TUNE_SEMANTICS=1``).
+- `runner` — scores one candidate on the live trainer through the
+  normal capture path; OOM = infeasible point, trial steps are marked
+  in telemetry.
+- `search` — successive-halving local search, ``MXTPU_TUNE_BUDGET``
+  trials per capture signature.
+- `db`     — crash-safe CRC'd JSONL next to the XLA compile cache,
+  keyed by (capture signature, device kind, mesh shape).
+
+`Trainer.train_step` calls `maybe_tune` once per capture signature:
+``MXTPU_AUTOTUNE=replay`` (default) applies a stored winner with zero
+trials, ``search`` searches when the DB has no entry and persists the
+winner, ``off`` does nothing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .. import telemetry
+from . import db, runner, search, space  # noqa: F401  (public submodules)
+
+#: guards re-entry: trial steps call Trainer.train_step, which calls
+#: maybe_tune again.
+_IN_PROGRESS = False
+
+
+def device_kind():
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def _mesh_shape(trainer):
+    from ..parallel.sharding import mesh_of_params
+
+    try:
+        mesh = mesh_of_params(list(trainer._params))
+    except Exception:
+        mesh = None
+    if mesh is None:
+        return None
+    return tuple(sorted(mesh.shape.items()))
+
+
+def _norm_name(name):
+    """Strip the per-process block-name counters ('dense3_weight' →
+    'dense_weight'): a restarted process must hash to the same
+    signature for the same model."""
+    import re
+
+    return re.sub(r"\d+", "", str(name))
+
+
+def signature_of(trainer, block, loss_fn, data, grad_accum):
+    """Stable per-process-independent capture signature: what model,
+    what parameters, what optimizer, what batch — the same identity
+    the capture cache keys on, minus object ids (a DB entry must
+    survive restarts)."""
+    params = []
+    for p in trainer._params:
+        params.append((_norm_name(getattr(p, "name", "")),
+                       tuple(getattr(p, "shape", ()) or ()),
+                       str(getattr(p, "dtype", "")),
+                       getattr(p, "_grad_req", "write")))
+    blob = json.dumps({
+        "block": type(block).__name__,
+        "loss": type(loss_fn).__name__,
+        "optimizer": type(trainer._optimizer).__name__,
+        "params": params,
+        "batch": [tuple(data.shape), str(data.dtype)],
+        "grad_accum": int(grad_accum),
+    }, sort_keys=True, default=str, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def effective_grad_accum(k, data):
+    """The semantics-changing grad-accum override: honored only behind
+    the MXTPU_TUNE_SEMANTICS opt-in, and only when it divides the
+    batch."""
+    if not space.semantics_opt_in():
+        return k
+    raw = os.environ.get("MXTPU_GRAD_ACCUM")
+    if not raw:
+        return k
+    try:
+        ka = int(raw)
+    except ValueError:
+        return k
+    if ka >= 1 and data.shape[0] % ka == 0:
+        return ka
+    return k
+
+
+def maybe_tune(trainer, block, loss_fn, data, label, grad_accum):
+    """Trainer.train_step hook.  Consults the tuning DB once per
+    (signature, device kind, mesh) on this trainer — replaying a stored
+    winner or (mode=search) running the successive-halving search on
+    the live trainer — then returns the effective grad-accum factor."""
+    global _IN_PROGRESS
+    mode = search.mode()
+    if mode == "off" or _IN_PROGRESS:
+        # trial steps still honor the candidate's grad-accum env
+        return effective_grad_accum(int(grad_accum), data)
+    # per-step fast path: the full signature hashes every param — only
+    # compute it the first time this cheap call shape appears
+    cheap = (id(block), id(loss_fn), tuple(data.shape),
+             str(data.dtype), int(grad_accum), mode)
+    seen = getattr(trainer, "_autotune_seen", None)
+    if seen is None:
+        seen = trainer._autotune_seen = set()
+    if cheap not in seen:
+        seen.add(cheap)
+        key = db.entry_key(
+            signature_of(trainer, block, loss_fn, data, grad_accum),
+            device_kind(), _mesh_shape(trainer))
+        entry = db.lookup(key)
+        if entry is not None:
+            space.apply_config(entry["config"])
+            telemetry.event("tune_db_hit", key=key,
+                            fingerprint=entry.get("fingerprint"),
+                            score_us=entry.get("score_us"))
+        elif mode == "search":
+            _search_and_apply(trainer, block, loss_fn, data, label,
+                              int(grad_accum), key)
+    return effective_grad_accum(int(grad_accum), data)
+
+
+def _search_and_apply(trainer, block, loss_fn, data, label,
+                      grad_accum, key):
+    """Run the search on the live trainer (trial steps DO advance the
+    weights — tuning is part of warmup), apply + persist the winner."""
+    global _IN_PROGRESS
+    base = space.current_config()
+    base_fp = space.fingerprint(base)
+
+    def step_fn():
+        trainer.train_step(block, loss_fn, data, label=label,
+                           grad_accum=grad_accum)
+
+    _IN_PROGRESS = True
+    try:
+        winner, results = search.successive_halving(step_fn, base=base)
+    finally:
+        _IN_PROGRESS = False
+    if not winner.feasible:
+        # every candidate OOM'd (shouldn't happen: base was running
+        # before the search) — keep defaults, record nothing
+        return
+    base_scores = [r.score_us for r in results
+                   if r.fingerprint == base_fp and r.feasible]
+    default_score = min(base_scores) if base_scores else None
+    space.apply_config(winner.config)
+    db.record(key, winner.config, winner.score_us, mfu=winner.mfu,
+              trials=len(results), default_score_us=default_score)
+    improvement = (default_score / winner.score_us) \
+        if default_score else None
+    telemetry.event(
+        "tune_winner", key=key, fingerprint=winner.fingerprint,
+        score_us=round(winner.score_us, 1),
+        default_score_us=None if default_score is None
+        else round(default_score, 1),
+        improvement=None if improvement is None
+        else round(improvement, 4),
+        trials=len(results))
+
+
+def sharded_signature(sharded_trainer, example):
+    """The ShardedTrainer analogue of `signature_of` (different attr
+    layout: explicit trainable list, pure optimizer, grad_accum)."""
+    import jax.tree_util as jtu
+
+    st = sharded_trainer
+    params = [(_norm_name(n), tuple(getattr(p, "shape", ()) or ()),
+               str(getattr(p, "dtype", "")))
+              for n, p in getattr(st, "_trainable", [])]
+    shapes = [(tuple(x.shape), str(x.dtype))
+              for x in jtu.tree_leaves(example)]
+    blob = json.dumps({
+        "block": type(st.block).__name__,
+        "loss": type(st.loss_fn).__name__,
+        "optimizer": st.optimizer.name,
+        "params": params,
+        "batch": shapes,
+        "grad_accum": int(st._grad_accum),
+    }, sort_keys=True, default=str, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def replay_for_sharded(signature, mesh):
+    """ShardedTrainer's capture-time DB consult: replay-only (the
+    sharded step path has its own build flow; searching it re-enters
+    compilation too deeply for a trial loop to pay off on-mesh).
+    Returns the applied entry or None."""
+    if search.mode() == "off":
+        return None
+    mesh_shape = None if mesh is None \
+        else tuple(sorted(mesh.shape.items()))
+    key = db.entry_key(signature, device_kind(), mesh_shape)
+    entry = db.lookup(key)
+    if entry is not None:
+        space.apply_config(entry["config"])
+        telemetry.event("tune_db_hit", key=key,
+                        fingerprint=entry.get("fingerprint"),
+                        score_us=entry.get("score_us"))
+    return entry

@@ -167,8 +167,6 @@ def ineligible_reason(trainer, block, loss_fn, data, grad_accum):
         return "block is not a HybridBlock"
     if not block._active:
         return "block is not hybridized"
-    if dict(block._flags).get("remat"):
-        return "remat-enabled block"
     if not callable(loss_fn):
         return "loss is not callable"
     if isinstance(loss_fn, _blockmod.Block) \
@@ -301,9 +299,19 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     has_scaler = getattr(trainer, "_amp_loss_scaler", None) is not None
     k = int(grad_accum)
     plan_sig = tuple(
-        (kernel, static_items, dt, tuple(i for i, *_r in items))
-        for (kernel, static_items, dt), items in groups.items())
+        gkey + (tuple(i for i, *_r in items),)
+        for gkey, items in groups.items())
     mesh, mesh_fp = _mesh_sharding_of(trainer)
+    # program-affecting knobs (remat policy from block flags or the
+    # MXTPU_REMAT/autotune env, optimizer group splitting): a changed
+    # value must MISS here and re-capture — the traced program differs.
+    # Non-program knobs (bucket MB, prefetch, ...) stay out of the key:
+    # their consumers re-read env at dispatch time, so a recompile
+    # would buy nothing.
+    from .. import remat as _remat
+    from ..autotune import space as _tune_space
+
+    remat_policy = _remat.env_default(dict(block._flags).get("remat"))
     key = (
         id(block), _tree_version(block),
         id(loss_fn), _tree_version(loss_fn),
@@ -314,6 +322,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
         None if label is None else (tuple(label.shape),
                                     str(_raw(label).dtype)),
         _kvs.device_fingerprint(), mesh_fp,
+        remat_policy, _tune_space.program_knob_values(),
     )
     cache = getattr(trainer, "_captured_cache", None)
     if cache is None:
@@ -327,7 +336,8 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     step = CapturedStep(trainer, block, loss_fn, trained, groups,
                         guard_on=guard_on, clip=clip,
                         has_scaler=has_scaler, grad_accum=k,
-                        has_label=label is not None, mesh=mesh)
+                        has_label=label is not None, mesh=mesh,
+                        remat=remat_policy)
     cap = capture_cache_size()
     while len(cache) >= cap:
         evicted_key = next(iter(cache))
@@ -357,7 +367,12 @@ class CapturedStep:
 
     def __init__(self, trainer, block, loss_fn, trained, groups,
                  guard_on, clip, has_scaler, grad_accum, has_label,
-                 mesh=None):
+                 mesh=None, remat=None):
+        # resolved remat policy (remat.py registry): checkpoint-style
+        # policies wrap the per-microbatch forward+loss closure below;
+        # 'save_every_k:N' instead applies inside the scanned trunk
+        # (ops/attention.py reads the env at trace time)
+        self._remat = remat
         # mesh the parameters are committed over (None = single-device):
         # batch inputs are placed over its dp axis, and the program's
         # param/state outputs are pinned to the input shardings so the
@@ -431,13 +446,18 @@ class CapturedStep:
         other_ids = [id(p) for _n, p in self._others]
         other_names = [n for n, _p in self._others]
         group_meta = []                 # (pure group fn, grad positions)
-        for (kernel, static_items, _dt), items in self._groups.items():
+        for gkey, items in self._groups.items():
+            kernel, static_items = gkey[0], gkey[1]
             if want_guard:
                 gfn = _grouped.build_group_step(
                     kernel, static_items, guarded=guard_on, clip=clip)
             else:
                 gfn = _grouped.build_group_step(kernel, static_items)
             group_meta.append((gfn, [self._pos[i] for i, *_r in items]))
+
+        from .. import remat as _remat
+
+        remat_policy = self._remat
 
         def micro(train_vals, others, x_mb, y_mb, kb, kl, scale):
             base_pm = dict(zip(other_ids, others))
@@ -463,6 +483,14 @@ class CapturedStep:
                             if y_mb is not None else loss_fn(out)
                 return loss, aux
 
+            if remat_policy:
+                # checkpoint-style remat around forward+loss: the
+                # backward recomputes the wrapped region instead of
+                # saving residuals.  Bitwise-neutral (jax.checkpoint
+                # replays identical HLO), proven by
+                # tests/test_autotune.py parity.  save_every_k is a
+                # no-op here — it lives inside the scanned trunk.
+                fwd = _remat.wrap(fwd, remat_policy)
             (loss, aux), vjp_fn = jax.vjp(fwd, list(train_vals))
             if has_scaler:
                 # eager: `loss * loss_scale` is its own program, and
@@ -562,11 +590,11 @@ class CapturedStep:
             for i in indices:
                 o._update_count(i)
             state_vals, dyn_list = [], []
-            for (_kern, _st, dt), items in self._groups.items():
+            for gkey, items in self._groups.items():
                 state_vals.append([[s._data for s in st]
                                    for _i, _w, _g, st, _d in items])
                 dyn_list.append(_grouped.dyn_columns(
-                    o, items, _np.dtype(dt)))
+                    o, items, _np.dtype(gkey[2])))
             k = self._grad_accum
             kbs, kls = [], []
             for _ in range(k):
@@ -623,7 +651,7 @@ class CapturedStep:
             p.data()._set_data(nw)
         for (_n, p), nv in zip(self._others, new_others):
             p.data()._set_data(nv)
-        for ((_kern, _st, _dt), items), ns_group in \
+        for (_gkey, items), ns_group in \
                 zip(self._groups.items(), new_states):
             for (_i, _w, _g, st, _d), ns in zip(items, ns_group):
                 for s_nd, s_new in zip(st, ns):

@@ -185,7 +185,13 @@ class Trainer:
             self._init_kvstore()
         if batch_size is None:
             batch_size = data.shape[0]
-        k = int(grad_accum)
+        # autotune consult (MXTPU_AUTOTUNE=replay|search|off): replay a
+        # stored winner or search the knob space ONCE per capture
+        # signature, before this step's capture lookup sees the knobs
+        from .. import autotune as _autotune
+
+        k = _autotune.maybe_tune(self, block, loss_fn, data, label,
+                                 int(grad_accum))
         self._maybe_shard_batch(data, label)
         acc = telemetry.step_begin()
         n_skipped = len(self.skipped_steps)

@@ -224,11 +224,35 @@ def scan_transformer_encoder(data, qkv_w, qkv_b, proj_w, proj_b,
         xs = xs + (qkv_lora_a, qkv_lora_b)
     if use_drop:
         xs = xs + (jax.random.split(_key, L),)
-    if remat:
+    from .. import remat as _remat
+
+    pol = _remat.trunk_policy(remat)
+    every = pol[1] if pol is not None and pol[0] == "every" else None
+    if every is not None and (L % every != 0 or every == 1):
+        # non-divisible chunking would need a ragged tail scan;
+        # degrade to per-layer (every=1 IS per-layer)
+        pol = ("layer", None)
+        every = None
+    if every is not None:
+        # chunked rematerialization (remat.py 'save_every_k:N'): scan
+        # L/N checkpointed chunks of N layers each — the backward keeps
+        # only chunk-boundary carries resident and recomputes inside a
+        # chunk.  The inner scan runs the SAME body on the same values
+        # as the flat scan, so the math is bitwise-unchanged.
+        def chunk(carry, per_chunk):
+            out, _ = jax.lax.scan(body, carry, per_chunk)
+            return out, None
+
+        chunk = jax.checkpoint(chunk)
+        xs = tuple(x.reshape((L // every, every) + x.shape[1:])
+                   for x in xs)
+        out, _ = jax.lax.scan(chunk, data, xs)
+        return layer_norm(out, lnf_g, lnf_b)
+    if pol is not None:
         # per-layer rematerialization: the backward recomputes each
         # layer's activations from its carry — O(1) layers of
         # activations resident instead of O(L) (the long-context knob;
         # composes with the reference's MXNET_BACKWARD_DO_MIRROR story)
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=pol[1])
     out, _ = jax.lax.scan(body, data, xs)
     return layer_norm(out, lnf_g, lnf_b)

@@ -48,6 +48,19 @@ def fused_step_enabled() -> bool:
         not in ("0", "false", "off", "")
 
 
+def group_max_items() -> int:
+    """MXTPU_GROUP_MAX_ITEMS: cap on params fused into one optimizer
+    group (0 = unlimited).  An autotune knob (autotune/space.py):
+    re-read on every `plan_items` call, so a mid-run change re-plans —
+    and, because the plan signature keys the capture cache, re-captures
+    — the next step.  Splitting is bitwise-neutral: the group kernel
+    loops per item, so chunk boundaries change fusion, never math."""
+    try:
+        return max(0, int(os.environ.get("MXTPU_GROUP_MAX_ITEMS", "0")))
+    except ValueError:
+        return 0
+
+
 # -- dispatch accounting (regression-tested: one jit call per group/step) ------
 
 _DISPATCH_COUNT = 0
@@ -423,6 +436,19 @@ def plan_items(updater, index, grad, weight):
         static_items = tuple(sorted(static.items()))
         gkey = (kernel, static_items, str(_raw(w).dtype))
         groups.setdefault(gkey, []).append((i, w, g, state_nds, dyn_fn))
+    cap = group_max_items()
+    if cap > 0:
+        # split oversize groups into chunks of <= cap items; the chunk
+        # ordinal extends the key (consumers index gkey[0..2], so the
+        # extra element is invisible to them)
+        split = {}
+        for gkey, items in groups.items():
+            if len(items) <= cap:
+                split[gkey] = items
+            else:
+                for ci in range(0, len(items), cap):
+                    split[gkey + (ci,)] = items[ci:ci + cap]
+        groups = split
     return groups, fallback
 
 
@@ -485,7 +511,8 @@ class GroupedUpdater:
             for i, *_ in items:
                 o._update_count(i)
         global _DISPATCH_COUNT
-        for (kernel, static_items, _dt), items in groups.items():
+        for gkey, items in groups.items():
+            kernel, static_items = gkey[0], gkey[1]
             dtype = _raw(items[0][1]).dtype
             w_raws = [_raw(w) for _, w, _, _, _ in items]
             g_raws = [_raw(g) for _, _, g, _, _ in items]

@@ -391,6 +391,14 @@ class ShardedTrainer:
         y = jtu.tree_map(to_raw, label)
         if not self._initialized:
             self._stage(jtu.tree_map(_from_jax, x))
+            # autotune DB consult at capture time (replay-only on the
+            # sharded path): a stored winner's knobs (bucket MB, FSDP
+            # min size, remat, ...) must be in env BEFORE the step
+            # program is traced
+            from .. import autotune as _autotune
+
+            _autotune.replay_for_sharded(
+                _autotune.sharded_signature(self, x), self.mesh)
             self._build_step()
         x = jax.device_put(x, self._batch_sharding)
         y = jax.device_put(y, self._batch_sharding)

@@ -1,4 +1,4 @@
-"""Memory-saving recompute (rematerialization).
+"""Memory-saving recompute (rematerialization) — named-policy registry.
 
 Reference parity: the gradient-mirroring pass enabled by
 ``MXNET_BACKWARD_DO_MIRROR`` (SURVEY.md §2.5 memory-saving recompute —
@@ -6,19 +6,32 @@ nnvm Gradient pass mirror_fun).  TPU-first, this is ``jax.checkpoint``:
 the backward pass recomputes activations instead of saving them, trading
 FLOPs for HBM.
 
-Knobs (either works):
+Knobs (any works; precedence explicit arg > MXTPU_REMAT >
+MXNET_BACKWARD_DO_MIRROR):
 - ``net.hybridize(remat='full'|'dots'|'dots_no_batch')``
 - ``parallel.ShardedTrainer(..., remat=...)``
-- env ``MXNET_BACKWARD_DO_MIRROR=1`` → default policy 'full' wherever no
-  explicit remat argument was given (the reference's env semantics).
+- env ``MXTPU_REMAT=<policy>`` → default policy wherever no explicit
+  remat argument was given (the autotuner's knob: every policy here is
+  numerics-preserving, so mxnet_tpu/autotune searches it by default).
+- env ``MXNET_BACKWARD_DO_MIRROR=1`` → default policy 'full' (the
+  reference's env semantics).
 
-Policies:
-- 'full'  (or True): save nothing — recompute the whole forward in the
-  backward pass (maximum memory saving, one extra forward of FLOPs).
-- 'dots': save MXU results (matmul/conv outputs), recompute the
-  cheap elementwise chains — the usual sweet spot on TPU, where HBM
+Registered policies (`names()`):
+- 'none': explicit no-remat — overrides MXNET_BACKWARD_DO_MIRROR.
+- 'full' (aliases 'all', True): save nothing — recompute the whole
+  forward in the backward pass (maximum memory saving, one extra
+  forward of FLOPs).
+- 'dots': save MXU results (matmul/conv outputs), recompute the cheap
+  elementwise chains — the usual sweet spot on TPU, where HBM
   bandwidth, not FLOPs, is the constraint.
 - 'dots_no_batch': like 'dots' but excludes batch-dim dots.
+- 'save_every_k:N': trunk-level policy over the scanned ``*_stack_*``
+  transformer trunk (ops/attention.py scan_transformer_encoder) — the
+  depth-L layer scan regroups into L/N chunks of N layers with one
+  ``jax.checkpoint`` per chunk, so O(L/N) chunk boundaries stay
+  resident instead of O(L) layers of activations.  `wrap` is a no-op
+  for it (the policy lives inside the scan, not at the jit boundary);
+  off-trunk models silently get no remat under it.
 """
 
 from __future__ import annotations
@@ -27,31 +40,126 @@ import os
 
 from .base import MXNetError
 
+_SAVE_EVERY_PREFIX = "save_every_k:"
+
+#: canonical policy name -> zero-arg factory returning the
+#: ``jax.checkpoint(policy=...)`` argument.  Extend with
+#: `register_policy`; parametric families (save_every_k:N) are handled
+#: structurally, not per-N.
+_REGISTRY = {}
+
+
+def register_policy(name, checkpoint_policy):
+    """Register a checkpoint-style remat policy: ``checkpoint_policy``
+    is a zero-arg factory returning the ``jax.checkpoint(policy=...)``
+    argument (None = save nothing)."""
+    _REGISTRY[name] = checkpoint_policy
+
+
+register_policy("full", lambda: None)
+register_policy("dots", lambda: __import__("jax").checkpoint_policies
+                .checkpoint_dots)
+register_policy("dots_no_batch",
+                lambda: __import__("jax").checkpoint_policies
+                .checkpoint_dots_with_no_batch_dims)
+
+
+def names():
+    """All selectable policy names (the parametric save_every_k family
+    is shown once, with its N placeholder)."""
+    return ("none",) + tuple(_REGISTRY) + ("all", "save_every_k:N")
+
+
+def parse_save_every(policy):
+    """N for 'save_every_k:N', else None."""
+    if isinstance(policy, str) and policy.startswith(_SAVE_EVERY_PREFIX):
+        try:
+            n = int(policy[len(_SAVE_EVERY_PREFIX):])
+        except ValueError:
+            raise MXNetError(f"bad remat policy {policy!r}: N must be "
+                             "an int >= 1")
+        if n < 1:
+            raise MXNetError(f"bad remat policy {policy!r}: N must be "
+                             ">= 1")
+        return n
+    return None
+
+
+def canonical(remat):
+    """Normalize a remat spec to a canonical policy name or None
+    (no remat).  Unknown names raise MXNetError."""
+    if remat is None or remat is False:
+        return None
+    if remat is True:
+        return "full"
+    name = str(remat)
+    if name in ("none", ""):
+        return None
+    if name == "all":
+        return "full"
+    if name in _REGISTRY or parse_save_every(name) is not None:
+        return name
+    raise MXNetError(
+        f"unknown remat policy {name!r}: use one of {names()}")
+
+
+def env_policy():
+    """The MXTPU_REMAT env policy (canonical), or None when
+    unset/'none'."""
+    return canonical(os.environ.get("MXTPU_REMAT") or None)
+
 
 def env_default(remat):
-    """Apply the MXNET_BACKWARD_DO_MIRROR env default when unset."""
-    if remat is None and os.environ.get("MXNET_BACKWARD_DO_MIRROR",
-                                        "0") not in ("0", ""):
+    """Resolve the effective policy: explicit argument first (including
+    an explicit 'none'), then MXTPU_REMAT, then the reference's
+    MXNET_BACKWARD_DO_MIRROR → 'full'."""
+    if remat is not None:
+        return canonical(remat)
+    raw = os.environ.get("MXTPU_REMAT")
+    if raw:
+        return canonical(raw)
+    if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", ""):
         return "full"
-    return remat
+    return None
 
 
 def wrap(fn, remat):
-    """Wrap a traceable function in jax.checkpoint per the policy name
-    (None → unchanged)."""
+    """Wrap a traceable function in jax.checkpoint per the policy
+    (None/'none' → unchanged).  'save_every_k:N' also returns the
+    function unchanged: that policy applies inside the scanned trunk
+    (`trunk_policy`), not at the jit boundary."""
     remat = env_default(remat)
-    if not remat:
+    if not remat or parse_save_every(remat) is not None:
         return fn
     import jax
 
-    if remat is True or remat == "full":
-        policy = None  # save nothing
-    elif remat == "dots":
-        policy = jax.checkpoint_policies.checkpoint_dots
-    elif remat == "dots_no_batch":
-        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-    else:
+    factory = _REGISTRY.get(remat)
+    if factory is None:
         raise MXNetError(
-            f"unknown remat policy {remat!r}: use 'full', 'dots', or "
-            f"'dots_no_batch'")
-    return jax.checkpoint(fn, policy=policy)
+            f"unknown remat policy {remat!r}: use one of {names()}")
+    return jax.checkpoint(fn, policy=factory())
+
+
+def trunk_policy(remat):
+    """Resolve the remat policy for the scanned transformer trunk.
+
+    Returns ('layer', checkpoint_policy) for per-layer checkpointing,
+    ('every', N) for chunked save_every_k, or None.  An explicit
+    truthy ``remat`` argument on the op wins (True → per-layer, the
+    pre-registry behaviour); otherwise only the env *save_every_k*
+    policy applies here — whole-fwd policies ('full'/'dots'/...) are
+    applied once at the capture/jit boundary by `wrap`, and applying
+    them per-layer too would checkpoint twice."""
+    if remat:
+        name = canonical(remat)
+        if name is None:
+            return None
+        n = parse_save_every(name)
+        if n is not None:
+            return ("every", n)
+        return ("layer", _REGISTRY[name]())
+    envp = env_default(None)
+    n = parse_save_every(envp)
+    if n is not None:
+        return ("every", n)
+    return None

@@ -67,6 +67,12 @@ Fault-injection sites (``MXTPU_FAULT_INJECT="site:arg,site:arg"``):
                           step tick (straggler injection)
 - ``heartbeat_loss:K``   — rank K stops publishing heartbeats while the
                           process keeps running (the wedged-alive mode)
+- ``corrupt_tune_db:N``  — bit-rot the next N tuning-DB entries as they
+                          are written (autotune/db.py; readers must fall
+                          back to defaults, never crash)
+- ``tune_oom:N``         — the next N autotune trials fail with a
+                          simulated RESOURCE_EXHAUSTED (the infeasible-
+                          point path, hermetic on CPU)
 
 Elastic gang recovery (PR 8) also lives here: :class:`HeartbeatPublisher`
 / :class:`FailureDetector` / :class:`StragglerMonitor` form the health
@@ -137,7 +143,14 @@ class _FaultPlan:
             if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
                         "crash_during_save", "crash_before_manifest",
                         "telemetry_crash", "corrupt_ckpt_write",
-                        "kill_coordinator"):
+                        "kill_coordinator", "corrupt_tune_db",
+                        "tune_oom"):
+                # corrupt_tune_db: bit-rot the next N tuning-DB entry
+                # lines as they are written (autotune/db.record) — the
+                # CRC check must read them as absent, never crash;
+                # tune_oom: the next N autotune trials raise a
+                # RESOURCE_EXHAUSTED (autotune/runner.run_trial) and
+                # must score infeasible
                 # kill_coordinator: the gang KV daemon
                 # (distributed.GangKVServer) drops dead on the Nth
                 # mutation — mid-protocol, no reply, connections cut —

@@ -45,7 +45,37 @@ import time
 
 _LOCK = threading.Lock()
 
-SCHEMA_VERSION = 1
+# v2 (autotune): step records gain optional ``tuning_trial`` (bool) and
+# ``config_fingerprint`` (str) fields; v1 records stay valid.
+SCHEMA_VERSION = 2
+_ACCEPTED_VERSIONS = (1, 2)
+
+# autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
+# config is being timed every step record is stamped
+# ``tuning_trial: true`` so steady-state consumers (recent_steps
+# default, trace_report aggregates, bench) exclude it; outside trials
+# an applied tuned config still stamps its fingerprint.
+_TRIAL_FP = None
+_CONFIG_FP = None
+
+
+def trial_begin(config_fingerprint):
+    """Mark subsequent step records as autotune trial steps."""
+    global _TRIAL_FP
+    _TRIAL_FP = str(config_fingerprint)
+
+
+def trial_end():
+    global _TRIAL_FP
+    _TRIAL_FP = None
+
+
+def set_config_fingerprint(config_fingerprint):
+    """Stamp steady-state step records with the applied (tuned) config
+    fingerprint; None clears."""
+    global _CONFIG_FP
+    _CONFIG_FP = None if config_fingerprint is None \
+        else str(config_fingerprint)
 
 #: bf16 peak FLOP/s per chip by device-kind substring (public specs).
 #: The ``cpu`` entry is a NOMINAL host figure so ratio gating works on
@@ -276,11 +306,15 @@ def _emit(record):
             pass               # telemetry must never kill training
 
 
-def recent_steps(path=None):
+def recent_steps(path=None, include_trials=False):
     """The in-memory ring of step records, oldest first (optionally
-    filtered by step path: 'captured' / 'eager' / 'manual')."""
+    filtered by step path: 'captured' / 'eager' / 'manual').  Autotune
+    trial steps are EXCLUDED by default: they time candidate configs,
+    not the run's steady state (pass include_trials=True to see them)."""
     with _LOCK:
         recs = [r for r in _RECENT if r.get("type") == "step"]
+    if not include_trials:
+        recs = [r for r in recs if not r.get("tuning_trial")]
     if path is not None:
         recs = [r for r in recs if r.get("path") == path]
     return recs
@@ -295,10 +329,13 @@ def reset(close_sink=True):
     """Drop ring, event counts, inter-step state, and (optionally) the
     sink handle — test isolation, not a runtime API."""
     global _SINK, _LAST_END, _LAST_COUNTS, _CURRENT, _PEAK_CACHE
+    global _TRIAL_FP, _CONFIG_FP
     with _LOCK:
         _RECENT.clear()
         _EVENT_COUNTS.clear()
     _CURRENT = None
+    _TRIAL_FP = None
+    _CONFIG_FP = None
     _LAST_END = None
     _LAST_COUNTS = {}
     _PEAK_CACHE = None
@@ -491,6 +528,11 @@ def step_end(acc, step=None, skipped=False):
         if peak:
             mfu = flops / (interval_us * 1e-6) / peak
     rec["mfu"] = round(mfu, 6) if mfu is not None else None
+    if _TRIAL_FP is not None:
+        rec["tuning_trial"] = True
+        rec["config_fingerprint"] = _TRIAL_FP
+    elif _CONFIG_FP is not None:
+        rec["config_fingerprint"] = _CONFIG_FP
     for k, v in acc.fields.items():
         rec[k] = v
     _emit(rec)
@@ -692,8 +734,9 @@ def validate_record(rec):
         fail("missing run id")
     if not isinstance(rec.get("t"), (int, float)):
         fail("missing timestamp t")
-    if rec.get("v") != SCHEMA_VERSION:
-        fail(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    if rec.get("v") not in _ACCEPTED_VERSIONS:
+        fail(f"schema version {rec.get('v')!r} not in "
+             f"{_ACCEPTED_VERSIONS}")
     if kind == "request":
         for key in ("queue_us", "prefill_us", "decode_us_per_token"):
             val = rec.get(key)
@@ -753,6 +796,14 @@ def validate_record(rec):
     if rec.get("cache_hit") is not None and \
             not isinstance(rec["cache_hit"], bool):
         fail("cache_hit must be a bool or null")
+    # optional autotune fields (schema v2): absent on untuned runs
+    tt = rec.get("tuning_trial")
+    if tt is not None and not isinstance(tt, bool):
+        fail("tuning_trial must be a bool or absent")
+    cfp = rec.get("config_fingerprint")
+    if cfp is not None and \
+            (not isinstance(cfp, str) or not cfp):
+        fail("config_fingerprint must be a non-empty string or absent")
     # optional sharded-step fields (PR 9): absent on unsharded runs
     cba = rec.get("collective_bytes_by_axis")
     if cba is not None:

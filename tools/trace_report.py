@@ -71,11 +71,17 @@ def _fmt(v, nd=1):
 
 
 def report_run(run, records, out):
-    steps = [r for r in records if r.get("type") == "step"]
+    all_steps = [r for r in records if r.get("type") == "step"]
+    # autotune trial steps time candidate configs, not the run: every
+    # steady-state aggregate below excludes them (they get their own
+    # section)
+    trials = [s for s in all_steps if s.get("tuning_trial")]
+    steps = [s for s in all_steps if not s.get("tuning_trial")]
     events = [r for r in records if r.get("type") == "event"]
     requests = [r for r in records if r.get("type") == "request"]
-    out.write(f"run {run}: {len(steps)} step records, "
-              f"{len(events)} events, {len(requests)} requests\n")
+    out.write(f"run {run}: {len(steps)} step records"
+              + (f" (+{len(trials)} tuning trials)" if trials else "")
+              + f", {len(events)} events, {len(requests)} requests\n")
     if requests:
         report_requests(requests, out)
     if steps:
@@ -120,6 +126,48 @@ def report_run(run, records, out):
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
         report_fleet(kinds, requests, out)
+        report_autotune(kinds, trials, out)
+    elif trials:
+        report_autotune({}, trials, out)
+
+
+def report_autotune(kinds, trials, out):
+    """Autotune section: trials run (with infeasible count), the
+    winning config and its measured improvement over defaults, and DB
+    activity — hits on restart (the zero-trial replay path), writes,
+    and corrupt-entry fallbacks.  Prints nothing when the run never
+    tuned."""
+    tune_kinds = ("tune_search_start", "tune_trial", "tune_infeasible",
+                  "tune_winner", "tune_db_hit", "tune_db_write",
+                  "tune_db_fallback")
+    if not any(k in kinds for k in tune_kinds) and not trials:
+        return
+    out.write("  autotune:\n")
+    n_trials = len(kinds.get("tune_trial", ())) or len(trials)
+    n_infeasible = len(kinds.get("tune_infeasible", ()))
+    if n_trials or n_infeasible:
+        out.write(f"    trials: {n_trials} scored, "
+                  f"{n_infeasible} infeasible (OOM)\n")
+    for e in kinds.get("tune_winner", ()):
+        imp = e.get("improvement")
+        vs = "" if imp is None else \
+            f"  ({imp:.3f}x vs default {_fmt(e.get('default_score_us'))}" \
+            f" us)"
+        out.write(f"    winner: {e.get('fingerprint', '?')} at "
+                  f"{_fmt(e.get('score_us'))} us/step{vs}\n")
+    hits = kinds.get("tune_db_hit", ())
+    if hits:
+        fps = sorted({e.get("fingerprint", "?") for e in hits})
+        out.write(f"    db hits (replayed with zero trials): "
+                  f"{len(hits)} ({', '.join(fps)})\n")
+    writes = len(kinds.get("tune_db_write", ()))
+    if writes:
+        out.write(f"    db writes: {writes}\n")
+    for e in kinds.get("tune_db_fallback", ()):
+        why = e.get("reason") or (
+            f"{e.get('corrupt_entries', 0)} corrupt, "
+            f"{e.get('stale_entries', 0)} stale entries")
+        out.write(f"    db fallback: {why} -> defaults kept\n")
 
 
 def report_requests(requests, out):
