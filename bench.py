@@ -261,6 +261,23 @@ def _serving_attempts(tpu_ok):
     return attempts
 
 
+def _obs_attempts(tpu_ok):
+    cfg = {"model": "obs",
+           "steps": int(os.environ.get("BENCH_OBS_STEPS", 300)),
+           "batch": int(os.environ.get("BENCH_OBS_BATCH", 512)),
+           "requests": int(os.environ.get("BENCH_OBS_REQUESTS", 8)),
+           "new_tokens": int(os.environ.get("BENCH_OBS_TOKENS", 4))}
+    attempts = []
+    if tpu_ok:
+        attempts.append((None, dict(cfg, backend="tpu"), 420))
+    # the obs plane (JSONL tail + rollup + HTTP scrape) is host-side,
+    # so the overhead RATIO is meaningful on any backend; CPU numbers
+    # survive only under obs_on_chip_unavailable tagging
+    attempts.append(({"JAX_PLATFORMS": "cpu"},
+                     dict(cfg, backend="cpu"), 420))
+    return attempts
+
+
 def _pipeline_attempts():
     # pure host work (decode/augment/collate) + device_put: always runs
     # on CPU so it never touches the tunnel and never needs a TPU probe
@@ -1000,6 +1017,13 @@ def orchestrate():
             serving = _run_worker(env_over, cfg, budget, serving_errors)
             if serving is not None:
                 break
+    obs = None
+    obs_errors = []
+    if headline is not None and not os.environ.get("BENCH_SKIP_OBS"):
+        for env_over, cfg, budget in _obs_attempts(tpu_ok):
+            obs = _run_worker(env_over, cfg, budget, obs_errors)
+            if obs is not None:
+                break
     recovery = None
     recovery_errors = []
     if headline is not None \
@@ -1205,6 +1229,39 @@ def orchestrate():
             }
     elif serving_errors:
         headline["serving_error"] = "; ".join(serving_errors)[-300:]
+    if obs is not None:
+        headline["obs_overhead_pct"] = obs["value"]
+        headline["obs_overhead_ratio"] = obs.get("obs_overhead_ratio")
+        headline["obs_step_us_base"] = obs.get("obs_step_us_base")
+        headline["obs_step_us_with"] = obs.get("obs_step_us_with")
+        headline["obs_exporter_scrapes_ok"] = \
+            obs.get("exporter_scrapes_ok")
+        headline["obs_spans_total"] = obs.get("spans_total")
+        headline["obs_spans_complete"] = obs.get("spans_complete")
+        # ratio gates (trainer_gates discipline): the live obs plane —
+        # collector tail + rollup publish + HTTP scrapes — must cost
+        # under 1% of the captured step, and every served request must
+        # render as ONE closed frontdoor→…→decode span tree
+        obs_gates = {
+            "obs_overhead_le_1pct":
+                obs.get("obs_overhead_ratio") is not None
+                and obs["obs_overhead_ratio"] <= 1.01,
+            "spans_complete":
+                bool(obs.get("spans_total"))
+                and obs.get("spans_complete") == obs.get("spans_total"),
+        }
+        headline["obs_gates"] = obs_gates
+        headline["obs_gates_ok"] = all(obs_gates.values())
+        if obs.get("backend") == "cpu":
+            headline["obs_on_chip_unavailable"] = {
+                "reason": probe_note if not tpu_ok
+                else "tpu attempts failed; cpu fallback produced the "
+                     "obs numbers",
+                "fallback_backend": "cpu",
+                "numbers_are_cpu": True,
+            }
+    elif obs_errors:
+        headline["obs_error"] = "; ".join(obs_errors)[-300:]
     if recovery:
         headline.update(recovery)
     if recovery_errors:
@@ -1490,6 +1547,8 @@ def worker(cfg):
         bench_autotune(cfg, devices)
     elif cfg["model"] == "serving":
         bench_serving(cfg, devices)
+    elif cfg["model"] == "obs":
+        bench_obs(cfg, devices)
     else:
         bench_resnet(cfg, devices)
 
@@ -2250,6 +2309,168 @@ def bench_serving(cfg, devices):
         "requests": n_requests,
         "new_tokens": new_tokens,
         "batch": max_bucket,
+        "backend": devices[0].platform,
+    }))
+
+
+def bench_obs(cfg, devices):
+    """obs_overhead_pct: the fleet observability plane must be free at
+    the train loop's timescale.  The SAME captured-step run (Dense-256
+    model, JSONL sink on for both halves — the sink itself is already
+    pinned <1% by the telemetry tests) is timed with the full plane
+    live — a HostCollector tailing the sink off the train thread and
+    publishing rollups on a FileKV, plus a MetricsExporter being
+    scraped over HTTP for the whole run — bracketed by a bare baseline
+    run on each side.  The median-step ratio vs the slower baseline is
+    the ``obs_overhead_le_1pct`` gate.  Second
+    half: N requests through FrontDoor → batcher → a real bucketed
+    engine must EACH yield exactly one closed span tree covering
+    frontdoor/batcher/prefill/decode — the span-completeness gate that
+    makes the fleet report's request view trustworthy end to end."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import distributed, gluon, serving, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.model_zoo import gpt
+    from mxnet_tpu.obs.collector import FleetView, HostCollector
+    from mxnet_tpu.obs.exporter import MetricsExporter
+
+    steps, batch = cfg["steps"], cfg["batch"]
+    work = tempfile.mkdtemp(prefix="bench_obs_")
+    os.environ["MXTPU_TELEMETRY_PATH"] = os.path.join(
+        work, "train_events.jsonl")
+    telemetry.reset()
+    telemetry.set_identity(rank=0, world=1)
+
+    # ~10ms steps on the CPU fallback: the record RATE (not the record
+    # cost) is what the collector pays for, so a microscopic step would
+    # feed it telemetry 100x faster than any real workload and pin the
+    # parse cost against nothing
+    units = 384
+    net = nn.HybridSequential()
+    net.add(nn.Dense(units, in_units=units, activation="relu"))
+    net.add(nn.Dense(units, in_units=units))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(batch, units).astype("float32"))
+    y = mx.nd.array(rng.rand(batch, units).astype("float32"))
+
+    def run(n):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            trainer.train_step(net, loss_fn, x, y)
+            times.append(time.perf_counter() - t0)
+        return times
+
+    run(5)                                 # warm: trace + compile
+    base_before = sorted(run(steps))[steps // 2]
+
+    kv = distributed.FileKV(os.path.join(work, "kv"))
+    collector = HostCollector(kv=kv, rank=0, world=1,
+                              period_s=0.5).start()
+    exporter = MetricsExporter(port=0, fleet=FleetView(kv))
+    scrapes = {"n": 0, "ok": 0}
+    stop = threading.Event()
+
+    def scrape_loop():
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        while not stop.is_set():
+            try:
+                body = urllib.request.urlopen(url, timeout=5).read()
+                scrapes["ok"] += int(b"mxtpu_" in body)
+            except Exception:
+                pass
+            scrapes["n"] += 1
+            stop.wait(1.0)
+
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+    run(5)                                 # settle with the plane live
+    withs = sorted(run(steps))[steps // 2]
+    stop.set()
+    scraper.join(timeout=5)
+    collector.poll_once()
+    rollup = kv.get_json("obs/rollup/0") or {}
+    collector.close()
+    exporter.close()
+    # bracketing baseline: on a shared host, run-to-run drift exceeds
+    # the true plane cost — a baseline on EACH side of the obs run
+    # (gate vs the slower one) keeps the gate about the plane, not the
+    # machine, while still catching anything train-thread-bounded
+    base_after = sorted(run(steps))[steps // 2]
+    base = max(base_before, base_after)
+    ratio = withs / base if base > 0 else None
+
+    # -- span completeness: the full ingress→decode request path -------------
+    np.random.seed(0)
+    mx.random.seed(0)
+    lm = gpt.gpt_tiny(scan_layers=True)
+    lm.initialize(init=mx.init.Xavier())
+    lm(mx.nd.array(np.random.randint(0, 128, (1, 8))
+                   .astype(np.float32)))
+    engine = serving.ServingEngine(lm, batch_buckets=(1, 2))
+    engine.warmup()
+    replica = serving.ReplicaServer(engine, max_delay_ms=2.0,
+                                    max_batch=2)
+    door = serving.FrontDoor([replica])
+    prng = np.random.RandomState(1)
+    futs = [door.submit(prng.randint(0, 128,
+                                     prng.randint(4, 9)).tolist(),
+                        cfg["new_tokens"])
+            for _ in range(cfg["requests"])]
+    for fut in futs:
+        fut.result(timeout=240)
+    replica.close()
+
+    need = {"frontdoor", "batcher", "prefill", "decode"}
+    recs = telemetry.recent_requests()
+    spans_total = spans_complete = 0
+    for rec in recs:
+        spans_total += 1
+        spans = rec.get("spans") or []
+        roots = [s for s in spans if s.get("parent") is None]
+        closed = bool(spans) and all(
+            isinstance(s.get("dur_us"), (int, float))
+            and s["dur_us"] >= 0 for s in spans)
+        ok = (len(roots) == 1 and closed
+              and need <= {s.get("name") for s in spans})
+        try:
+            telemetry.validate_record(rec)
+        except Exception:
+            ok = False
+        spans_complete += int(ok)
+
+    print(json.dumps({
+        "metric": "obs_overhead_pct",
+        "value": round((ratio - 1.0) * 100.0, 3)
+        if ratio is not None else None,
+        "unit": "% captured-step overhead",
+        "vs_baseline": None,
+        "obs_step_us_base": round(base * 1e6, 1),
+        "obs_step_us_base_before": round(base_before * 1e6, 1),
+        "obs_step_us_base_after": round(base_after * 1e6, 1),
+        "obs_step_us_with": round(withs * 1e6, 1),
+        "obs_overhead_ratio": round(ratio, 4)
+        if ratio is not None else None,
+        "collector_polls": collector.polls,
+        "rollup_steps_total": rollup.get("steps_total"),
+        "exporter_scrapes": scrapes["n"],
+        "exporter_scrapes_ok": scrapes["ok"],
+        "spans_total": spans_total,
+        "spans_complete": spans_complete,
+        "requests": cfg["requests"],
+        "new_tokens": cfg["new_tokens"],
+        "steps": steps,
         "backend": devices[0].platform,
     }))
 

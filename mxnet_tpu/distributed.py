@@ -94,6 +94,14 @@ def init_from_env():
     _rendezvous(coord, int(os.environ["MXTPU_NUM_WORKERS"]),
                 int(os.environ["MXTPU_WORKER_RANK"]))
     _INITIALIZED = True
+    try:
+        from . import telemetry
+
+        telemetry.set_identity(
+            rank=int(os.environ["MXTPU_WORKER_RANK"]),
+            world=int(os.environ["MXTPU_NUM_WORKERS"]))
+    except (ImportError, ValueError):
+        pass
     return True
 
 

@@ -30,8 +30,9 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, clip_global_norm=None):
-        from .. import engine
+        from .. import engine, obs
         engine.ensure_compile_cache()  # MXTPU_COMPILE_CACHE_DIR, if set
+        obs.ensure_from_env()          # MXTPU_METRICS_PORT, if set
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):

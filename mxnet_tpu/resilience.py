@@ -125,6 +125,16 @@ def _tel_event(kind, **fields):
     telemetry.event(kind, **fields)
 
 
+def _tel_identity(rank=None, world=None):
+    """Stamp this process's fleet identity onto telemetry records
+    (schema v3) — same import guard as _tel_event."""
+    try:
+        from . import telemetry
+    except ImportError:
+        return
+    telemetry.set_identity(rank=rank, world=world)
+
+
 # -- fault injection -----------------------------------------------------------
 
 class _FaultPlan:
@@ -142,9 +152,13 @@ class _FaultPlan:
             site, _, arg = item.partition(":")
             if site in ("rendezvous", "io_open", "nan_grad", "inf_loss",
                         "crash_during_save", "crash_before_manifest",
-                        "telemetry_crash", "corrupt_ckpt_write",
+                        "telemetry_crash", "telemetry_rotate",
+                        "corrupt_ckpt_write",
                         "kill_coordinator", "corrupt_tune_db",
                         "tune_oom"):
+                # telemetry_rotate: crash between the telemetry sink's
+                # rename-to-.1 and the reopen (telemetry._rotate_locked)
+                # — the torn-rotation window readers must survive
                 # corrupt_tune_db: bit-rot the next N tuning-DB entry
                 # lines as they are written (autotune/db.record) — the
                 # CRC check must read them as absent, never crash;
@@ -1311,6 +1325,7 @@ class ElasticGang:
         self.rank = int(rank)
         self.members = list(range(int(world)))
         self.epoch = 0
+        _tel_identity(rank=self.rank, world=len(self.members))
         self.checkpointer = checkpointer
         self.peer_snap_every = int(
             os.environ.get("MXTPU_PEER_SNAP_EVERY", 10)
@@ -1364,6 +1379,7 @@ class ElasticGang:
                 and self.rank in cur.get("members", []):
             self.epoch = int(cur["epoch"])
             self.members = list(cur["members"])
+            _tel_identity(rank=self.rank, world=len(self.members))
             self.detector = FailureDetector(
                 self.kv, self.rank, self.members,
                 timeout=self.detector.timeout)
@@ -1634,6 +1650,7 @@ class ElasticGang:
         # adopt the new membership
         self.epoch = epoch
         self.members = new_members
+        _tel_identity(rank=self.rank, world=len(self.members))
         for d in dead:
             self.detector.forget(d)
         for j in joined:

@@ -27,6 +27,7 @@ from concurrent.futures import Future
 
 from .. import telemetry
 from ..base import MXNetError, getenv_int
+from ..obs.spans import Trace
 
 
 class ServerOverloaded(MXNetError):
@@ -57,15 +58,28 @@ _SHUTDOWN = object()    # close() sentinel: wakes the blocked collector
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_enqueue",
-                 "deadline")
+                 "deadline", "trace", "span", "qspan")
 
-    def __init__(self, prompt, max_new_tokens, deadline_ms=None):
+    def __init__(self, prompt, max_new_tokens, deadline_ms=None,
+                 trace=None, replica_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = (None if deadline_ms is None
                          else self.t_enqueue + float(deadline_ms) / 1e3)
+        # span tree (obs/spans.py): a FrontDoor-minted trace arrives
+        # with an open root; a direct submit roots at the batcher
+        t_wall = time.time()
+        if trace is None:
+            trace = Trace()
+            self.span = trace.begin("batcher", t0=t_wall,
+                                    replica_id=replica_id)
+        else:
+            self.span = trace.begin("batcher", parent=trace.root(),
+                                    t0=t_wall, replica_id=replica_id)
+        self.trace = trace
+        self.qspan = trace.begin("queue", parent=self.span, t0=t_wall)
 
 
 class ContinuousBatcher:
@@ -79,8 +93,9 @@ class ContinuousBatcher:
 
     def __init__(self, engine, max_delay_ms=None, max_batch=None,
                  before_batch=None, temperature=None, rng=None,
-                 max_queue=None):
+                 max_queue=None, replica_id=None):
         self.engine = engine
+        self.replica_id = replica_id
         self.max_delay_ms = (max_delay_ms_from_env()
                              if max_delay_ms is None else max_delay_ms)
         self.max_batch = max_batch or max(engine.batch_buckets)
@@ -99,16 +114,20 @@ class ContinuousBatcher:
                                         name="mxtpu-batcher", daemon=True)
         self._thread.start()
 
-    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               trace=None):
         """Enqueue one request → Future.  Raises
         :class:`ServerOverloaded` when the admission queue is full (the
         caller — or its FrontDoor — decides whether to retry elsewhere);
         a ``deadline_ms`` budget resolves the future with
         :class:`DeadlineExceeded` if group formation can't reach it in
-        time."""
+        time.  ``trace``: an obs.spans.Trace minted upstream (the
+        FrontDoor) — batcher/prefill/decode spans attach under its
+        root; None mints a batcher-rooted trace."""
         if self._stop.is_set():
             raise RuntimeError("batcher is closed")
-        req = _Request(prompt, max_new_tokens, deadline_ms)
+        req = _Request(prompt, max_new_tokens, deadline_ms,
+                       trace=trace, replica_id=self.replica_id)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -163,11 +182,16 @@ class ContinuousBatcher:
                 continue
             self.deadline_exceeded += 1
             telemetry.count("serving.deadline_exceeded")
+            queue_us = (now - r.t_enqueue) * 1e6
+            r.qspan.close(dur_us=queue_us)
+            r.span.attrs["deadline_exceeded"] = True
+            r.trace.close_open()
             telemetry.request_record(
-                queue_us=(now - r.t_enqueue) * 1e6,
+                queue_us=queue_us,
                 prefill_us=0.0, decode_us_per_token=0.0,
                 bucket=[1, 1], padded_fraction=0.0, new_tokens=0,
-                deadline_exceeded=True)
+                deadline_exceeded=True, replica_id=self.replica_id,
+                **r.trace.to_fields())
             if not r.future.cancelled():
                 r.future.set_exception(DeadlineExceeded(
                     f"deadline passed after "
@@ -193,11 +217,29 @@ class ContinuousBatcher:
             return
         self.groups_served += 1
         self.requests_served += len(group)
+        t_done = time.time()
         for r, toks in zip(group, outs):
             queue_us = (t_batch - r.t_enqueue) * 1e6
             rec = dict(timings)
             rec["queue_us"] = queue_us
             rec["tokens"] = toks
+            # close the request's span tree from the group's stage
+            # clocks — no extra timing work, the engine already took
+            # these readings (obs/spans.py)
+            r.qspan.close(dur_us=queue_us)
+            r.trace.begin("prefill", parent=r.span,
+                          t0=timings.get("t_prefill0"),
+                          bucket=f"{timings['bucket'][0]}x"
+                                 f"{timings['bucket'][1]}",
+                          generation=timings["generation"]) \
+                .close(dur_us=timings["prefill_us"])
+            r.trace.begin("decode", parent=r.span,
+                          t0=timings.get("t_decode0"),
+                          new_tokens=len(toks)) \
+                .close(dur_us=timings.get(
+                    "decode_us",
+                    timings["decode_us_per_token"] * len(toks)))
+            r.trace.close_open(t_end=t_done)
             telemetry.request_record(
                 queue_us=queue_us,
                 prefill_us=timings["prefill_us"],
@@ -206,7 +248,8 @@ class ContinuousBatcher:
                 padded_fraction=timings["padded_fraction"],
                 new_tokens=len(toks),
                 generation=timings["generation"],
-                deadline_exceeded=False)
+                deadline_exceeded=False, replica_id=self.replica_id,
+                **r.trace.to_fields())
             if not r.future.cancelled():
                 r.future.set_result(rec)
 

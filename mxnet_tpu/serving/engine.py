@@ -493,12 +493,14 @@ class ServingEngine:
         for i, p in enumerate(prompts):
             toks[i, :lens[i]] = np.asarray(p, np.int32)
         t0 = time.perf_counter()
+        t_prefill0 = time.time()
         ck, cv = self.init_cache(B)
         ck, cv, logits = self._call(B, S, ck, cv,
                                     np.zeros(B, np.int32), toks)
         last = np.asarray(logits)[np.arange(B), lens - 1]
         prefill_us = (time.perf_counter() - t0) * 1e6
         t1 = time.perf_counter()
+        t_decode0 = time.time()
         out = np.zeros((B, steps), np.int32)
         for j in range(steps):
             nxt = _sample(last, temperature, rng)
@@ -515,5 +517,10 @@ class ServingEngine:
             "padded_fraction": round(
                 1.0 - float(lens[:n].sum()) / float(B * S), 4),
             "generation": self.generation,
+            # wall-clock stage starts + total decode time: span
+            # material for obs/spans.py (host clock reads only)
+            "t_prefill0": t_prefill0,
+            "t_decode0": t_decode0,
+            "decode_us": decode_us,
         }
         return [out[i, :per_req[i]].copy() for i in range(n)], timings

@@ -24,6 +24,7 @@ import time
 
 from .. import telemetry
 from ..base import MXNetError, getenv_int
+from ..obs.spans import Trace
 from .batcher import ContinuousBatcher, ServerOverloaded
 
 
@@ -56,7 +57,7 @@ class ReplicaServer:
         self.batcher = ContinuousBatcher(
             engine, max_delay_ms=max_delay_ms, max_batch=max_batch,
             before_batch=self._maybe_swap, temperature=temperature,
-            rng=rng)
+            rng=rng, replica_id=rank)
         self._hb = None
         if kv is not None:
             from ..resilience import HeartbeatPublisher
@@ -70,9 +71,10 @@ class ReplicaServer:
                 daemon=True)
             self._poller.start()
 
-    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               trace=None):
         return self.batcher.submit(prompt, max_new_tokens,
-                                   deadline_ms=deadline_ms)
+                                   deadline_ms=deadline_ms, trace=trace)
 
     # -- hot reload ------------------------------------------------------------
 
@@ -169,13 +171,21 @@ class FrontDoor:
         with self._lock:
             start = self._rr
             self._rr += 1
+        # the distributed trace is minted HERE — the fleet's ingress —
+        # so a shed-retry onto another replica stays ONE causal tree
+        # with the retry visible as a root attr (obs/spans.py)
+        trace = Trace()
+        root = trace.begin("frontdoor")
         last_exc = None
         shed = 0
         for i in range(len(live)):
             r = live[(start + i) % len(live)]
             try:
-                return r.submit(prompt, max_new_tokens,
-                                deadline_ms=deadline_ms)
+                fut = r.submit(prompt, max_new_tokens,
+                               deadline_ms=deadline_ms, trace=trace)
+                if shed:
+                    root.attrs["retries"] = shed
+                return fut
             except ServerOverloaded as exc:
                 last_exc = exc
                 shed += 1

@@ -47,8 +47,12 @@ _LOCK = threading.Lock()
 
 # v2 (autotune): step records gain optional ``tuning_trial`` (bool) and
 # ``config_fingerprint`` (str) fields; v1 records stay valid.
-SCHEMA_VERSION = 2
-_ACCEPTED_VERSIONS = (1, 2)
+# v3 (fleet observability): every record may carry ``rank`` / ``world``
+# / ``replica_id`` identity fields, and request records may carry a
+# ``trace_id`` plus a closed ``spans`` tree (obs/spans.py); v1/v2
+# records stay valid.
+SCHEMA_VERSION = 3
+_ACCEPTED_VERSIONS = (1, 2, 3)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -243,6 +247,7 @@ def observe(name, v):
 
 _RUN_ID = f"{os.getpid():x}-{int(time.time() * 1000) & 0xffffffff:08x}"
 _SINK = None          # (path, file object)
+_SINK_SIZE = 0        # bytes written to the current sink file
 _RECENT = []          # bounded ring of step records (bench.py reads it)
 _RECENT_MAX = 256
 _EVENT_COUNTS = {}    # event kind -> count (cheap test/report surface)
@@ -252,10 +257,60 @@ def run_id() -> str:
     return _RUN_ID
 
 
+# -- fleet identity (schema v3) ------------------------------------------------
+#
+# Every record is stamped with the emitting process's place in the
+# fleet so the obs collector can aggregate per-rank logs into one
+# FleetView.  Identity resolves lazily from MXTPU_WORKER_RANK /
+# MXTPU_NUM_WORKERS and is overridden explicitly by ElasticGang /
+# ReplicaServer via set_identity() (reshapes update world in place).
+# The dict is cached: stamping costs two dict lookups per record,
+# invisible against the <1% overhead budget.
+
+_IDENT = None
+
+
+def _identity() -> dict:
+    global _IDENT
+    if _IDENT is None:
+        ident = {}
+        try:
+            r = os.environ.get("MXTPU_WORKER_RANK")
+            w = os.environ.get("MXTPU_NUM_WORKERS")
+            if r is not None:
+                ident["rank"] = int(r)
+            if w is not None:
+                ident["world"] = int(w)
+        except ValueError:
+            ident = {}
+        _IDENT = ident
+    return _IDENT
+
+
+def set_identity(rank=None, world=None, replica_id=None):
+    """Declare this process's fleet identity; subsequent records carry
+    the fields.  Partial updates merge (a reshape only changes world)."""
+    global _IDENT
+    ident = dict(_identity())
+    if rank is not None:
+        ident["rank"] = int(rank)
+    if world is not None:
+        ident["world"] = int(world)
+    if replica_id is not None:
+        ident["replica_id"] = int(replica_id)
+    _IDENT = ident
+
+
+def identity() -> dict:
+    """The current identity stamp (possibly empty) — read surface for
+    obs/collector.py and tests."""
+    return dict(_identity())
+
+
 def _sink_file():
     """Lazily opened append-only JSONL file; reopened if the configured
     path changes (tests point it at per-test tmp dirs)."""
-    global _SINK
+    global _SINK, _SINK_SIZE
     path = telemetry_path()
     with _LOCK:
         if path is None:
@@ -274,14 +329,60 @@ def _sink_file():
                     pass
             f = open(path, "a", encoding="utf-8")
             _SINK = (path, f)
+            try:
+                _SINK_SIZE = os.path.getsize(path)
+            except OSError:
+                _SINK_SIZE = 0
         return _SINK[1]
+
+
+def _max_sink_bytes():
+    """MXTPU_TELEMETRY_MAX_MB → byte cap on the JSONL sink, or None
+    (unbounded, the default)."""
+    raw = os.environ.get("MXTPU_TELEMETRY_MAX_MB")
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        return None
+    return int(mb * 1e6) if mb > 0 else None
+
+
+def _rotate_locked(res):
+    """Rotate the sink: close, rename to ``<path>.1`` (atomic on the
+    same filesystem), reopen fresh.  Caller holds _LOCK.  The
+    ``telemetry_rotate`` fault site crashes BETWEEN the rename and the
+    reopen — the torn-rotation window readers must survive (``.1``
+    complete, the live path momentarily absent)."""
+    global _SINK, _SINK_SIZE
+    path, f = _SINK
+    try:
+        f.close()
+    except OSError:
+        pass
+    try:
+        os.replace(path, path + ".1")
+    except OSError:
+        pass               # rename failure: keep appending in place
+    if res is not None and res.consume_fault("telemetry_rotate"):
+        os._exit(res.CRASH_EXIT_CODE)
+    nf = open(path, "a", encoding="utf-8")
+    _SINK = (path, nf)
+    _SINK_SIZE = 0
+    return nf
 
 
 def _emit(record):
     """Append one record to the ring and (when configured) the JSONL
     log.  One line per record, flushed immediately: a crash between
     records loses nothing, a crash mid-write truncates only the last
-    line (readers skip it)."""
+    line (readers skip it).  When MXTPU_TELEMETRY_MAX_MB is set the
+    sink rotates to ``<path>.1`` before the write that would cross the
+    cap."""
+    global _SINK_SIZE
+    for k, v in _identity().items():
+        record.setdefault(k, v)
     with _LOCK:
         _RECENT.append(record)
         del _RECENT[:-_RECENT_MAX]
@@ -294,6 +395,10 @@ def _emit(record):
     except ImportError:        # standalone import (tools/trace_report)
         _res = None
     with _LOCK:
+        cap = _max_sink_bytes()
+        if cap is not None and _SINK_SIZE > 0 \
+                and _SINK_SIZE + len(line) > cap:
+            f = _rotate_locked(_res)
         if _res is not None and _res.consume_fault("telemetry_crash"):
             # hermetic crash-mid-append: half a line, then power loss
             f.write(line[:max(1, len(line) // 2)])
@@ -302,17 +407,123 @@ def _emit(record):
         try:
             f.write(line)
             f.flush()
+            _SINK_SIZE += len(line)
         except OSError:
             pass               # telemetry must never kill training
 
 
-def recent_steps(path=None, include_trials=False):
-    """The in-memory ring of step records, oldest first (optionally
-    filtered by step path: 'captured' / 'eager' / 'manual').  Autotune
+# -- incremental JSONL tailing (obs/collector.py polls these) ------------------
+#
+# The collector re-reads the per-rank logs every MXTPU_OBS_ROLLUP_SECS;
+# a full re-parse would be O(log size) per poll.  Each tailed path
+# keeps a seek offset so a poll costs O(new bytes) — pinned by
+# tests/test_obs.py via tail_bytes_read().  Rotation (the sink moving
+# to ``<path>.1`` under the reader) is detected by inode change or
+# shrink; the remainder of the rotated file is drained from the old
+# offset before the fresh file is read from 0, so no record is lost
+# across the boundary — including the torn-rotation window where the
+# live path briefly does not exist.
+
+_TAILS = {}           # path -> {"off", "ino", "r1_off"}
+_TAIL_RINGS = {}      # path -> bounded list of parsed records
+_TAIL_BYTES = 0       # total bytes read by _read_lines (test pin)
+
+
+def tail_bytes_read() -> int:
+    return _TAIL_BYTES
+
+
+def _read_lines(path, start):
+    """Parse complete JSONL lines from `path` starting at byte
+    `start`; returns (records, new_offset).  The offset only advances
+    past the last newline, so a half-flushed tail is re-read (not
+    skipped) on the next poll."""
+    global _TAIL_BYTES
+    try:
+        with open(path, "rb") as f:
+            f.seek(start)
+            data = f.read()
+    except OSError:
+        return [], start
+    if not data:
+        return [], start
+    _TAIL_BYTES += len(data)
+    nl = data.rfind(b"\n")
+    if nl < 0:
+        return [], start
+    recs = []
+    for raw in data[:nl + 1].splitlines():
+        try:
+            recs.append(json.loads(raw))
+        except ValueError:
+            pass               # torn line mid-file (crash artifact)
+    return recs, start + nl + 1
+
+
+def tail_records(path):
+    """Newly appended records of `path` since the previous call
+    (per-path seek offset; O(new bytes)), reading across a sink
+    rotation without loss."""
+    st = _TAILS.get(path)
+    if st is None:
+        # bootstrap: an already-rotated predecessor (including the
+        # torn-rotation case where the live path does not exist yet)
+        # is drained before the live file, oldest records first
+        st = _TAILS[path] = {
+            "off": 0, "ino": None,
+            "r1_off": 0 if os.path.exists(path + ".1") else None}
+    try:
+        s = os.stat(path)
+        size, ino = s.st_size, s.st_ino
+    except OSError:
+        size = ino = None
+    rotated = (
+        (size is None and st["off"] > 0) or
+        (size is not None and size < st["off"]) or
+        (ino is not None and st["ino"] is not None and ino != st["ino"]))
+    out = []
+    if rotated:
+        # what we were reading is now <path>.1: drain its remainder
+        if st["r1_off"] is None:
+            st["r1_off"] = st["off"]
+        st["off"] = 0
+        st["ino"] = None
+    if st["r1_off"] is not None:
+        recs, new_off = _read_lines(path + ".1", st["r1_off"])
+        out.extend(recs)
+        # keep tracking .1 only while the live file is absent (torn
+        # rotation); once it exists the rotated file is frozen
+        st["r1_off"] = new_off if size is None else None
+    if size is not None:
+        recs, st["off"] = _read_lines(path, st["off"])
+        st["ino"] = ino
+        out.extend(recs)
+    return out
+
+
+def _tail_ring(path):
+    ring = _TAIL_RINGS.get(path)
+    if ring is None:
+        ring = _TAIL_RINGS[path] = []
+    new = tail_records(path)
+    if new:
+        ring.extend(new)
+        del ring[:-_RECENT_MAX]
+    return ring
+
+
+def recent_steps(path=None, include_trials=False, jsonl=None):
+    """Step records, oldest first (optionally filtered by step path:
+    'captured' / 'eager' / 'manual').  Default source is the in-memory
+    ring; pass ``jsonl=`` to incrementally tail a JSONL log instead
+    (O(new lines) per call — the collector's read path).  Autotune
     trial steps are EXCLUDED by default: they time candidate configs,
     not the run's steady state (pass include_trials=True to see them)."""
-    with _LOCK:
-        recs = [r for r in _RECENT if r.get("type") == "step"]
+    if jsonl is not None:
+        recs = [r for r in _tail_ring(jsonl) if r.get("type") == "step"]
+    else:
+        with _LOCK:
+            recs = [r for r in _RECENT if r.get("type") == "step"]
     if not include_trials:
         recs = [r for r in recs if not r.get("tuning_trial")]
     if path is not None:
@@ -328,8 +539,8 @@ def event_counts() -> dict:
 def reset(close_sink=True):
     """Drop ring, event counts, inter-step state, and (optionally) the
     sink handle — test isolation, not a runtime API."""
-    global _SINK, _LAST_END, _LAST_COUNTS, _CURRENT, _PEAK_CACHE
-    global _TRIAL_FP, _CONFIG_FP
+    global _SINK, _SINK_SIZE, _LAST_END, _LAST_COUNTS, _CURRENT
+    global _PEAK_CACHE, _TRIAL_FP, _CONFIG_FP, _IDENT, _TAIL_BYTES
     with _LOCK:
         _RECENT.clear()
         _EVENT_COUNTS.clear()
@@ -339,6 +550,11 @@ def reset(close_sink=True):
     _LAST_END = None
     _LAST_COUNTS = {}
     _PEAK_CACHE = None
+    _IDENT = None
+    _TAILS.clear()
+    _TAIL_RINGS.clear()
+    _TAIL_BYTES = 0
+    _SINK_SIZE = 0
     if close_sink and _SINK is not None:
         try:
             _SINK[1].close()
@@ -389,8 +605,11 @@ def request_record(queue_us, prefill_us, decode_us_per_token, bucket,
     _emit(rec)
 
 
-def recent_requests():
-    """The in-memory ring of per-request serving records, oldest first."""
+def recent_requests(jsonl=None):
+    """Per-request serving records, oldest first: the in-memory ring,
+    or (with ``jsonl=``) an incrementally tailed JSONL log."""
+    if jsonl is not None:
+        return [r for r in _tail_ring(jsonl) if r.get("type") == "request"]
     with _LOCK:
         return [r for r in _RECENT if r.get("type") == "request"]
 
@@ -718,6 +937,41 @@ def collective_bytes_by_axis(compiled, mesh):
 
 # -- schema validation (tests + tools/trace_report.py --validate) --------------
 
+def _validate_spans(spans, fail):
+    """A request's ``spans`` field must be one CLOSED causal tree:
+    every span has an id/name/t0/dur_us, exactly one root (parent
+    null), and every parent id resolves inside the list."""
+    if not isinstance(spans, list) or not spans:
+        fail("spans must be a non-empty list")
+    ids = set()
+    roots = 0
+    for sp in spans:
+        if not isinstance(sp, dict):
+            fail("each span must be an object")
+        sid = sp.get("span_id")
+        if not isinstance(sid, str) or not sid:
+            fail("span_id must be a non-empty string")
+        if sid in ids:
+            fail(f"duplicate span_id {sid!r}")
+        ids.add(sid)
+        if not isinstance(sp.get("name"), str) or not sp["name"]:
+            fail("span name must be a non-empty string")
+        if not isinstance(sp.get("t0"), (int, float)):
+            fail("span t0 must be a number (epoch seconds)")
+        dur = sp.get("dur_us")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            fail("span dur_us must be a non-negative number "
+                 "(open spans may not be emitted)")
+        if sp.get("parent") is None:
+            roots += 1
+    if roots != 1:
+        fail(f"spans must have exactly one root, got {roots}")
+    for sp in spans:
+        parent = sp.get("parent")
+        if parent is not None and parent not in ids:
+            fail(f"span parent {parent!r} not in tree")
+
+
 def validate_record(rec):
     """Raise ValueError unless `rec` is a well-formed telemetry record.
     The authoritative schema spec lives in docs/observability.md."""
@@ -737,7 +991,19 @@ def validate_record(rec):
     if rec.get("v") not in _ACCEPTED_VERSIONS:
         fail(f"schema version {rec.get('v')!r} not in "
              f"{_ACCEPTED_VERSIONS}")
+    # optional fleet-identity fields (schema v3): any record type
+    for key, lo in (("rank", 0), ("world", 1), ("replica_id", 0)):
+        val = rec.get(key)
+        if val is not None and (not isinstance(val, int) or
+                                isinstance(val, bool) or val < lo):
+            fail(f"{key} must be an int >= {lo} or absent")
     if kind == "request":
+        tid = rec.get("trace_id")
+        if tid is not None and (not isinstance(tid, str) or not tid):
+            fail("trace_id must be a non-empty string or absent")
+        spans = rec.get("spans")
+        if spans is not None:
+            _validate_spans(spans, fail)
         for key in ("queue_us", "prefill_us", "decode_us_per_token"):
             val = rec.get(key)
             if not isinstance(val, (int, float)) or val < 0:

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""One rank of the fleet-observability acceptance run
+(tests/test_obs.py::test_fleet_observability_end_to_end).
+
+Each rank runs a bounded-staleness "training" loop: per step it emits a
+real telemetry step record (step_begin / on_scope / step_end, so MFU
+and the breakdown shares are the production code path), ticks its
+`ElasticGang`, then waits until every live peer's heartbeat-published
+step is within LAG steps — measuring that wait as the collective share
+it feeds the StragglerMonitor.  A `slow_rank` fault on one rank makes
+it fall >LAG behind, so the fast ranks genuinely stall in "collective"
+while the slow rank's own interval lands in "other" — the exact
+correlation `FleetView._stragglers` renders.
+
+A `HostCollector` per rank tails the rank's own JSONL and publishes
+rollups at ``obs/rollup/<rank>`` on the shared FileKV; one rank can be
+told to die silently mid-run (MXTPU_OBS_EXIT_RANK/STEP) so the
+survivors reshape and the fleet timeline gains mesh_reshape/rank_dead.
+
+Protocol lines on stdout (flushed, parsed by the test):
+
+    PID <rank> <pid>
+    RESULT <json>   (rank, final_step, epoch, members, reshapes)
+
+Usage:  obs_fleet_worker.py <work_dir> <num_steps> [work_ms]
+Env:    MXTPU_WORKER_RANK, MXTPU_NUM_WORKERS, MXTPU_GANG_DIR,
+        MXTPU_TELEMETRY_PATH (per rank), MXTPU_PEAK_FLOPS, plus the
+        heartbeat/straggler knobs the test sets.
+"""
+
+import importlib
+import json
+import os
+import sys
+import time
+import types
+
+LAG = 2          # bounded staleness: how far a peer may trail
+
+
+def _emit(line):
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
+def _import_modules():
+    """Load the needed submodules without executing the package
+    __init__ (keeps the worker jax-free and spawn cheap)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "mxnet_tpu" not in sys.modules:
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = pkg
+    tel = importlib.import_module("mxnet_tpu.telemetry")
+    res = importlib.import_module("mxnet_tpu.resilience")
+    dist = importlib.import_module("mxnet_tpu.distributed")
+    col = importlib.import_module("mxnet_tpu.obs.collector")
+    return tel, res, dist, col
+
+
+def _wait_peers(gang, res, step, timeout=15.0):
+    """Block until every live peer has published step >= step - LAG;
+    raises RankFailure when a peer is confirmed dead instead."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        steps = gang.detector.peer_steps()
+        live = [p for p in gang.members if p != gang.rank]
+        if all(steps.get(p) is not None and steps[p] >= step - LAG
+               for p in live):
+            return
+        dead = gang.detector.poll() & set(gang.members)
+        dead.discard(gang.rank)
+        if dead:
+            raise res.RankFailure(dead, gang.epoch)
+        time.sleep(0.005)
+
+
+def main():
+    tel, res, dist, col = _import_modules()
+
+    work_dir = sys.argv[1]
+    num_steps = int(sys.argv[2])
+    work_s = (float(sys.argv[3]) / 1e3) if len(sys.argv) > 3 else 0.02
+    rank = int(os.environ["MXTPU_WORKER_RANK"])
+    world = int(os.environ["MXTPU_NUM_WORKERS"])
+    exit_rank = int(os.environ.get("MXTPU_OBS_EXIT_RANK", "-1"))
+    exit_step = int(os.environ.get("MXTPU_OBS_EXIT_STEP", "-1"))
+
+    _emit(f"PID {rank} {os.getpid()}")
+
+    kv = dist.gang_kv()
+    assert kv is not None, "worker needs MXTPU_GANG_DIR"
+    gang = res.ElasticGang(rank, world, kv=kv, peer_snap_every=1)
+    gang.start()
+    collector = col.HostCollector(kv=kv, rank=rank, world=world,
+                                  period_s=0.15).start()
+
+    state = {"w": [float(rank)], "opt": 0.0}
+    step = 0
+    prev_share = None
+    stats = {"reshapes": 0}
+
+    try:
+        while step < num_steps:
+            if rank == exit_rank and step == exit_step:
+                # silent death: heartbeats stop, survivors reshape
+                os._exit(0)
+            t_iter = time.perf_counter()
+            try:
+                gang.step_tick(step, state=state,
+                               collective_share=prev_share)
+                # slow_rank fault slept inside step_tick: that stall is
+                # the gap BETWEEN this rank's step records ("other")
+                acc = tel.step_begin(path="captured")
+                time.sleep(work_s)                    # the "compute"
+                tel.on_scope("captured_step", work_s)
+                tel.note(flops=float(
+                    os.environ.get("MXTPU_OBS_STEP_FLOPS", 1e9)))
+                t_w = time.perf_counter()
+                _wait_peers(gang, res, step)
+                wait_s = time.perf_counter() - t_w
+                tel.on_scope("allreduce", wait_s)     # stall bucket
+                tel.step_end(acc, step=step)
+                total = time.perf_counter() - t_iter
+                prev_share = wait_s / total if total > 0 else 0.0
+            except res.RankFailure as rf:
+                tel.step_abort(tel._CURRENT)
+                info = gang.recover(rf)
+                step = info.snap_step
+                stats["reshapes"] += 1
+                continue
+            step += 1
+        collector.poll_once()          # final rollup with every step
+        collector.close()
+        gang.stop()
+    except res.GangEvicted:
+        _emit(f"EVICTED {rank}")
+        return 0
+    _emit("RESULT " + json.dumps(
+        {"rank": rank, "final_step": step, "epoch": gang.epoch,
+         "members": gang.members, "reshapes": stats["reshapes"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,7 +324,8 @@ class _StubReplica:
         self.shed = shed        # raise ServerOverloaded this many times
         self.calls = 0
 
-    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               trace=None):
         self.calls += 1
         if self.fail:
             raise RuntimeError("replica down")

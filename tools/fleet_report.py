@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Fleet-wide view over per-rank telemetry logs (ISSUE 14).
+
+Where tools/trace_report.py narrates ONE process's JSONL, this merges
+the logs of a whole fleet — every training rank plus every serving
+replica — into a single picture:
+
+- fleet summary: ranks seen, total steps, step-weighted fleet MFU;
+- per-rank timeline table: steps, mean interval, MFU, and the
+  breakdown-share columns side by side, so a straggler's signature
+  (everyone else's ``collective`` share up, the laggard's own time in
+  ``other``/compute) is visible at a glance;
+- skew + straggler attribution: `StragglerMonitor` suspicions
+  correlated with the named rank's own breakdown and its slowdown
+  against the fleet-median step interval;
+- reshape/drain timeline: elastic events from all ranks merged in
+  time order (epochs, deaths, drains, rejoins, scale decisions);
+- request span trees: each served request's FrontDoor → batcher →
+  prefill/decode waterfall rendered from the ``spans`` field the
+  serving path embeds in request records (obs/spans.py).
+
+Stdlib-only, like trace_report: pull the JSONLs off the pods, read
+them anywhere.  Rotated predecessors (``<path>.1``) are read
+automatically.  ``--validate`` loads mxnet_tpu/telemetry.py standalone
+and checks every record against the schema PLUS span-tree completeness
+(every request carrying a trace renders exactly one closed tree) —
+exit 1 on any violation.
+
+Usage:
+    python tools/fleet_report.py rank0.jsonl rank1.jsonl ... [--validate]
+    python tools/fleet_report.py logdir/            # all *.jsonl inside
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+BREAKDOWN_KEYS = ("data", "host_prep", "dispatch", "readback",
+                  "collective", "other")
+
+TIMELINE_KINDS = ("mesh_reshape", "rank_drained", "rank_dead",
+                  "rank_rejoin", "elastic_recover", "scale_up",
+                  "scale_down", "gang_drain_scheduled", "chips_freed",
+                  "straggler_suspected", "resume", "restart",
+                  "serving_reload", "serving_replica_failover",
+                  "serving_replica_spawned", "profile_captured")
+
+
+def expand_paths(args_paths):
+    """Files as given; directories expand to their *.jsonl members
+    (rotated ``.1`` files are folded into their live log, not listed)."""
+    paths = []
+    for p in args_paths:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            paths.append(p)
+    return [p for p in paths if not p.endswith(".1")]
+
+
+def read_records(path):
+    """One log, rotation-aware: ``<path>.1`` first (if present), then
+    the live file; torn lines are skipped, never fatal."""
+    records, bad = [], 0
+    for candidate in (path + ".1", path):
+        if not os.path.exists(candidate):
+            continue
+        with open(candidate, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records, bad
+
+
+def _mean(vals):
+    vals = [v for v in vals if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _median(vals):
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    n = len(vals)
+    return vals[n // 2] if n % 2 else \
+        (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+
+
+def _fmt(v, nd=1):
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def group_by_rank(records):
+    """{rank: [records]} — records without a rank field (pre-v3 logs)
+    land under None."""
+    ranks = {}
+    for rec in records:
+        key = rec.get("rank")
+        if key is None and rec.get("replica_id") is not None:
+            key = f"replica{rec['replica_id']}"
+        ranks.setdefault(key, []).append(rec)
+    return ranks
+
+
+def rank_stats(records):
+    steps = [r for r in records if r.get("type") == "step"
+             and not r.get("tuning_trial")]
+    shares = {}
+    for k in BREAKDOWN_KEYS:
+        shares[k] = _mean([s.get("shares", {}).get(k) for s in steps])
+    return {
+        "steps": len(steps),
+        "interval_us": _mean([s.get("interval_us") for s in steps]),
+        "mfu": _mean([s.get("mfu") for s in steps]),
+        "shares": shares,
+        "requests": sum(1 for r in records if r.get("type") == "request"),
+    }
+
+
+def report_fleet_summary(ranks, out):
+    stats = {r: rank_stats(recs) for r, recs in ranks.items()}
+    train = {r: s for r, s in stats.items() if s["steps"]}
+    total_steps = sum(s["steps"] for s in stats.values())
+    worlds = {rec.get("world") for recs in ranks.values()
+              for rec in recs if rec.get("world") is not None}
+    out.write(f"fleet: {len(ranks)} rank(s)"
+              + (f", world {max(worlds)}" if worlds else "")
+              + f", {total_steps} steps, "
+              f"{sum(s['requests'] for s in stats.values())} "
+              f"request(s)\n")
+    num = den = 0.0
+    for s in train.values():
+        if s["mfu"] is not None:
+            num += s["mfu"] * s["steps"]
+            den += s["steps"]
+    if den:
+        out.write(f"fleet mfu (step-weighted): {num / den:.5f}\n")
+    if train:
+        out.write("per-rank breakdown (mean share of step interval):\n")
+        hdr = (f"  {'rank':>6}{'steps':>7}{'interval_us':>13}"
+               f"{'mfu':>9}")
+        for k in BREAKDOWN_KEYS:
+            hdr += f"{k:>11}"
+        out.write(hdr + "\n")
+        for r in sorted(train, key=lambda x: (str(type(x)), str(x))):
+            s = train[r]
+            row = (f"  {str(r):>6}{s['steps']:>7}"
+                   f"{_fmt(s['interval_us']):>13}"
+                   f"{_fmt(s['mfu'], 5) if s['mfu'] is not None else '-':>9}")
+            for k in BREAKDOWN_KEYS:
+                row += f"{_fmt(s['shares'][k], 3):>11}"
+            out.write(row + "\n")
+    return stats
+
+
+def report_skew_and_stragglers(ranks, stats, out):
+    train = {r: s for r, s in stats.items()
+             if s["steps"] and s["interval_us"]}
+    if len(train) > 1:
+        slow = max(train, key=lambda r: train[r]["interval_us"])
+        lo = min(s["interval_us"] for s in train.values())
+        hi = train[slow]["interval_us"]
+        if lo > 0:
+            out.write(f"step-time skew: {hi / lo:.2f}x "
+                      f"(slowest rank {slow} at {_fmt(hi)} us)\n")
+    med = _median([s["interval_us"] for s in train.values()])
+    seen = set()
+    for r, recs in sorted(ranks.items(), key=lambda kv: str(kv[0])):
+        for e in recs:
+            if e.get("type") != "event" \
+                    or e.get("event") != "straggler_suspected":
+                continue
+            named = e.get("rank")
+            if named in seen:
+                continue
+            seen.add(named)
+            line = (f"straggler: rank {named} suspected "
+                    f"(mean collective share "
+                    f"{_fmt(e.get('mean_collective_share'), 3)} "
+                    f"across peers)")
+            target = stats.get(named)
+            if target and target["steps"]:
+                shares = {k: v for k, v in target["shares"].items()
+                          if v is not None}
+                if shares:
+                    bucket = max(shares, key=shares.get)
+                    line += (f"; its own time: {bucket} "
+                             f"{shares[bucket]:.3f}")
+                if target["interval_us"] and med:
+                    line += (f"; {target['interval_us'] / med:.2f}x "
+                             f"the fleet-median step interval")
+            out.write(line + "\n")
+
+
+def report_timeline(records, out):
+    events = [r for r in records if r.get("type") == "event"
+              and r.get("event") in TIMELINE_KINDS
+              and r.get("t") is not None]
+    if not events:
+        return
+    events.sort(key=lambda e: e["t"])
+    t0 = events[0]["t"]
+    out.write("timeline:\n")
+    for e in events:
+        who = f" [rank {e['rank']}]" if e.get("rank") is not None else ""
+        detail = []
+        for k in ("epoch", "world", "members", "step", "planned",
+                  "generation", "path", "steps"):
+            if e.get(k) is not None:
+                detail.append(f"{k}={e[k]}")
+        out.write(f"  +{e['t'] - t0:8.2f}s  {e['event']}{who}"
+                  f"{('  ' + ' '.join(detail)) if detail else ''}\n")
+
+
+def render_span_tree(spans):
+    """ASCII waterfall of one request's span list (same shape as
+    obs/spans.render_tree, duplicated here so this tool stays
+    standalone-importable without the package)."""
+    by_parent = {}
+    for sp in spans:
+        by_parent.setdefault(sp.get("parent"), []).append(sp)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("t0", 0.0))
+    lines = []
+
+    def walk(sp, depth):
+        attrs = sp.get("attrs") or {}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        dur = sp.get("dur_us")
+        dur_txt = f"{dur / 1000.0:8.2f} ms" if dur is not None \
+            else "    open  "
+        lines.append(f"  {'  ' * depth}{sp['name']:<12} {dur_txt}"
+                     f"{('  ' + extra) if extra else ''}")
+        for kid in by_parent.get(sp.get("span_id"), []):
+            walk(kid, depth + 1)
+
+    for root in by_parent.get(None, []):
+        walk(root, 0)
+    return lines
+
+
+def report_spans(records, out, limit=8):
+    traced = [r for r in records if r.get("type") == "request"
+              and r.get("spans")]
+    if not traced:
+        return
+    out.write(f"request span trees ({len(traced)} traced request(s), "
+              f"showing up to {limit}):\n")
+    for r in traced[:limit]:
+        who = f" replica {r['replica_id']}" \
+            if r.get("replica_id") is not None else ""
+        out.write(f"trace {r.get('trace_id', '?')}{who}:\n")
+        for line in render_span_tree(r["spans"]):
+            out.write(line + "\n")
+
+
+def check_spans(records):
+    """Span-completeness check: every request carrying a trace renders
+    exactly ONE closed tree (one root, every parent resolvable, every
+    span closed).  Returns a list of violation strings."""
+    errors = []
+    for i, r in enumerate(records):
+        if r.get("type") != "request" or "trace_id" not in r:
+            continue
+        spans = r.get("spans")
+        if not spans:
+            errors.append(f"record {i}: trace_id without spans")
+            continue
+        ids = {sp.get("span_id") for sp in spans}
+        roots = [sp for sp in spans if sp.get("parent") is None]
+        if len(roots) != 1:
+            errors.append(f"record {i}: {len(roots)} roots "
+                          f"(want exactly 1)")
+        for sp in spans:
+            if sp.get("dur_us") is None:
+                errors.append(f"record {i}: open span "
+                              f"{sp.get('name')!r}")
+            p = sp.get("parent")
+            if p is not None and p not in ids:
+                errors.append(f"record {i}: dangling parent {p!r}")
+    return errors
+
+
+def validate_all(records):
+    """Schema-validate every record via mxnet_tpu/telemetry.py loaded
+    standalone (no package import, no jax needed)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu", "telemetry.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_telemetry",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors = []
+    for i, rec in enumerate(records):
+        try:
+            mod.validate_record(rec)
+        except ValueError as e:
+            errors.append(f"record {i}: {e}")
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank telemetry JSONLs into one fleet "
+                    "view")
+    ap.add_argument("paths", nargs="+",
+                    help="JSONL log(s) or directory of *.jsonl")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-validate every record and check span "
+                         "tree completeness; exit 1 on violations")
+    ap.add_argument("--spans", type=int, default=8,
+                    help="max span trees to render (default 8)")
+    args = ap.parse_args(argv)
+    paths = expand_paths(args.paths)
+    if not paths:
+        sys.stderr.write("error: no logs found\n")
+        return 2
+    records, bad = [], 0
+    for p in paths:
+        if not os.path.exists(p) and not os.path.exists(p + ".1"):
+            sys.stderr.write(f"error: no such file: {p}\n")
+            return 2
+        recs, b = read_records(p)
+        records.extend(recs)
+        bad += b
+    if not records:
+        sys.stderr.write("error: no parseable records\n")
+        return 2
+    if args.validate:
+        errors = validate_all(records) + check_spans(records)
+        if errors:
+            for err in errors:
+                sys.stderr.write(f"violation: {err}\n")
+            return 1
+        print(f"{len(records)} records from {len(paths)} log(s) "
+              f"validate (schema + span completeness)")
+    ranks = group_by_rank(records)
+    stats = report_fleet_summary(ranks, sys.stdout)
+    report_skew_and_stragglers(ranks, stats, sys.stdout)
+    report_timeline(records, sys.stdout)
+    report_spans(records, sys.stdout, limit=args.spans)
+    if bad:
+        print(f"({bad} unparseable line(s) skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,8 +31,24 @@ BREAKDOWN_KEYS = ("data", "host_prep", "dispatch", "readback",
 
 
 def read_records(path):
-    """Parse one JSONL file; a truncated tail (crash mid-append) is
-    skipped with a warning, never a crash."""
+    """Parse one JSONL log; when a rotated predecessor ``<path>.1``
+    exists (MXTPU_TELEMETRY_MAX_MB size cap) it is read FIRST, so the
+    report spans the rotation boundary.  A truncated tail (crash
+    mid-append) is skipped with a warning, never a crash."""
+    records, bad = [], 0
+    rotated = path + ".1"
+    if os.path.exists(rotated):
+        recs, b = _read_one(rotated)
+        records.extend(recs)
+        bad += b
+    if os.path.exists(path):
+        recs, b = _read_one(path)
+        records.extend(recs)
+        bad += b
+    return records, bad
+
+
+def _read_one(path):
     records, bad = [], 0
     with open(path, "r") as f:
         for ln, line in enumerate(f, 1):
@@ -340,7 +356,8 @@ def main(argv=None):
     ap.add_argument("--validate", action="store_true",
                     help="validate every record against the schema")
     args = ap.parse_args(argv)
-    if not os.path.exists(args.path):
+    if not os.path.exists(args.path) \
+            and not os.path.exists(args.path + ".1"):
         sys.stderr.write(f"error: no such file: {args.path}\n")
         return 2
     records, bad = read_records(args.path)
