@@ -44,6 +44,7 @@ import threading
 import time
 import zlib
 
+from . import integrity
 from . import resilience
 from . import telemetry
 from .base import MXNetError
@@ -493,6 +494,12 @@ class AsyncCheckpointer:
                     "skeleton": skeleton,
                     "leaf_meta": leaf_meta,
                     "shards": shards}
+        stamp = integrity.manifest_stamp()
+        if stamp is not None:
+            # tier-3 provenance: the attestation-ledger head at commit
+            # time — restore audits it back to the chain (optional key,
+            # same manifest version: old readers ignore it)
+            manifest["integrity"] = stamp
         mpath = os.path.join(sdir, "MANIFEST.json")
         with open(mpath + ".tmp", "w") as f:
             json.dump(manifest, f)
@@ -506,7 +513,8 @@ class AsyncCheckpointer:
         """``corrupt_shard:K``: bit-rot shard K of the checkpoint that
         just committed (tests the CRC fail-closed path + fallback)."""
         k = resilience.fault_arg("corrupt_shard")
-        if k is None or not resilience.consume_fault("corrupt_shard"):
+        if k is None or not resilience.consume_charges(
+                "corrupt_shard", on_last=False):
             return
         path = os.path.join(sdir, self._shard_name(int(k)))
         with open(path, "r+b") as f:
@@ -622,6 +630,11 @@ class AsyncCheckpointer:
                 raise MXNetError(f"no checkpoints under {self._dir}")
         with resilience.guard_checkpoint(f"ckpt_restore:{step}"):
             m = self._manifest(step)
+            ok, why = integrity.verify_provenance(m)
+            if not ok:
+                raise CheckpointCorrupt(
+                    f"checkpoint step {step}: integrity provenance "
+                    f"failed — {why}")
             if template is None and self.world_size > 1 \
                     and self._use_barrier \
                     and m["world_size"] != self.world_size:
@@ -668,9 +681,12 @@ class AsyncCheckpointer:
 
     def verify(self, step):
         """Re-read manifest + every shard, checksum-validated (the
-        verify-after-write hook `resilience._save_verified` calls)."""
+        verify-after-write hook `resilience._save_verified` calls).
+        Returns the validated manifest so callers (the serving reload
+        gate) can audit its integrity stamp without a second read."""
         m = self._manifest(step)
         self._load_leaves(step, m)
+        return m
 
     # -- listing ---------------------------------------------------------------
 

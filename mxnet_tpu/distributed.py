@@ -524,10 +524,9 @@ class GangKVServer:
                     return
                 self.requests += 1
                 if code in (_OP_PUT, _OP_DEL) and \
-                        resilience.consume_fault("kill_coordinator") and \
-                        not resilience.fault_armed("kill_coordinator"):
-                    # the consumed charge was the last: this is the Nth
-                    # mutation of a kill_coordinator:N plan
+                        resilience.consume_charges("kill_coordinator"):
+                    # fires on the LAST charge: the Nth mutation of a
+                    # kill_coordinator:N plan
                     # injected coordinator death: cut every client off
                     # mid-request, no reply — the worst-timed crash
                     self.die()

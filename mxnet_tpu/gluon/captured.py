@@ -308,6 +308,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     # Non-program knobs (bucket MB, prefetch, ...) stay out of the key:
     # their consumers re-read env at dispatch time, so a recompile
     # would buy nothing.
+    from .. import integrity as _integrity
     from .. import remat as _remat
     from ..autotune import space as _tune_space
 
@@ -323,6 +324,10 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
                                     str(_raw(label).dtype)),
         _kvs.device_fingerprint(), mesh_fp,
         remat_policy, _tune_space.program_knob_values(),
+        # integrity attestation adds a program output (the state
+        # fingerprint) — a toggled flag must re-capture, and the
+        # disabled program is bitwise-identical to the pre-integrity one
+        _integrity.fingerprint_enabled(),
     )
     cache = getattr(trainer, "_captured_cache", None)
     if cache is None:
@@ -406,6 +411,15 @@ class CapturedStep:
         self._compiled = _SENTINEL_UNSET
         self._collective_bytes = _SENTINEL_UNSET
         self._peak_bytes = _SENTINEL_UNSET
+        from .. import integrity as _integrity
+
+        # integrity plane (integrity.py): when enabled, the program
+        # grows a trailing STATIC ``attest`` flag and a sixth output —
+        # the parameter+optimizer-state fingerprint, computed in-program
+        # (zero extra dispatches) only by the attest-step specialization;
+        # the non-attest specialization is the plain step plus a
+        # constant-zeros output
+        self._want_fp = _integrity.fingerprint_enabled()
         self._fn = self._build()
 
     # -- trace ------------------------------------------------------------------
@@ -566,7 +580,36 @@ class CapturedStep:
                               for v, s in zip(new_others, other_shs)]
             return new_train, new_others, new_states, losses, health
 
-        return jax.jit(pure_step, donate_argnums=(0, 1, 2))
+        if not self._want_fp:
+            return jax.jit(pure_step, donate_argnums=(0, 1, 2))
+
+        from .. import integrity as _integrity
+
+        def pure_step_fp(train_vals, other_vals, state_vals, dyn_list,
+                         xs, ys, keys_b, keys_l, scale, attest):
+            # ``attest`` is STATIC: jit specializes into exactly two
+            # executables (one trace + compile each, cached by jit).
+            # The non-attest executable is the plain step plus a
+            # constant-zeros output — XLA dead-code-eliminates the
+            # whole fingerprint, so steady-state overhead is ~0.  (A
+            # traced predicate under lax.cond was measurably worse:
+            # every param+state array becomes a conditional operand,
+            # which blocks fusion/aliasing on EVERY step.)
+            new_train, new_others, new_states, losses, health = \
+                pure_step(train_vals, other_vals, state_vals, dyn_list,
+                          xs, ys, keys_b, keys_l, scale)
+            if attest:
+                flat_states = [a for group in new_states
+                               for item in group for a in item]
+                fp = _integrity.fingerprint_arrays(
+                    list(new_train) + flat_states)
+            else:
+                fp = jnp.zeros((2,), jnp.uint32)
+            return (new_train, new_others, new_states, losses, health,
+                    fp)
+
+        return jax.jit(pure_step_fp, donate_argnums=(0, 1, 2),
+                       static_argnums=(9,))
 
     # -- per-step host driver ---------------------------------------------------
 
@@ -642,10 +685,21 @@ class CapturedStep:
                 self._arg_specs = _arg_specs_of(
                     (train_raws, other_raws, state_vals, dyn_list,
                      xs, ys, keys_b, keys_l, scale))
+        fp = None
         with profiler.annotate("captured_step"):
-            new_train, new_others, new_states, losses, health = self._fn(
-                train_raws, other_raws, state_vals, dyn_list,
-                xs, ys, keys_b, keys_l, scale)
+            if self._want_fp:
+                attest = bool(trainer._integrity_due())
+                (new_train, new_others, new_states, losses, health,
+                 fp) = self._fn(
+                    train_raws, other_raws, state_vals, dyn_list,
+                    xs, ys, keys_b, keys_l, scale, attest)
+                if not attest:
+                    fp = None
+            else:
+                new_train, new_others, new_states, losses, health = \
+                    self._fn(
+                        train_raws, other_raws, state_vals, dyn_list,
+                        xs, ys, keys_b, keys_l, scale)
         _DISPATCH_COUNT += 1
         for (_i, p), nw in zip(self._trained, new_train):
             p.data()._set_data(nw)
@@ -656,11 +710,30 @@ class CapturedStep:
             for (_i, _w, _g, st, _d), ns in zip(items, ns_group):
                 for s_nd, s_new in zip(st, ns):
                     s_nd._set_data(s_new)
+        from .. import resilience as _resilience
+
+        if _resilience.fault_armed("bit_flip_param"):
+            # memory-SDC injection: corrupt the LIVE post-step state
+            # after the program committed — the in-program fingerprint
+            # is clean, so the flip surfaces at the NEXT attestation
+            # (within one interval) and a shadow replay disagrees with
+            # the live state (kind="memory")
+            from .. import integrity as _integrity
+
+            _integrity.maybe_bit_flip_param(
+                params=[p for _i, p in self._trained])
         trainer._step_count += 1
         if self._want_guard:
             guard = numerics.StepGuard(health, skip=self._guard_on,
-                                       clip=self._clip)
+                                       clip=self._clip, extra=fp)
             trainer._finalize_guarded_step(guard, snapshot)
+        elif fp is not None:
+            # no numerics guard: the attestation readback is the step's
+            # one host sync instead
+            from .. import integrity as _integrity
+
+            trainer._integrity_attest(
+                _integrity.combine(_np.asarray(fp)))
         return _from_jax(losses)
 
     # -- program accounting (mxnet_tpu/telemetry.py) ----------------------------
@@ -677,8 +750,13 @@ class CapturedStep:
             if self._arg_specs is not None:
                 saved = _TRACE_COUNT
                 try:
+                    # the integrity program carries a trailing static
+                    # attest flag: lower the non-attest specialization
+                    # (the one every steady-state step runs)
+                    args = tuple(self._arg_specs) + (False,) \
+                        if self._want_fp else self._arg_specs
                     self._compiled = \
-                        self._fn.lower(*self._arg_specs).compile()
+                        self._fn.lower(*args).compile()
                 except Exception:
                     self._compiled = None
                 finally:

@@ -67,6 +67,10 @@ class Trainer:
         self.divergence_monitor = None
         self.skipped_steps = []
         self._step_count = 0
+        # integrity plane (mxnet_tpu/integrity.py): attach_integrity
+        # makes the captured step fingerprint the state every
+        # plane.every steps and attest it against the gang
+        self._integrity_plane = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -198,10 +202,12 @@ class Trainer:
         n_skipped = len(self.skipped_steps)
         step = None
         try:
-            # a pending nan_grad injection needs a materialized gradient
-            # buffer to land in: route that step to the eager oracle
+            # a pending nan_grad / bit_flip_grad injection needs a
+            # materialized gradient buffer to land in: route that step
+            # to the eager oracle
             if _captured.captured_step_enabled() \
-                    and not resilience.fault_armed("nan_grad"):
+                    and not resilience.fault_armed("nan_grad") \
+                    and not resilience.fault_armed("bit_flip_grad"):
                 hits0 = _captured.cache_stats()["hits"] if acc else 0
                 step = _captured.get_step(self, block, loss_fn, data,
                                           label, k)
@@ -386,9 +392,13 @@ class Trainer:
         guard_on = numerics.grad_guard_enabled()
         clip = self._clip_norm()
         if fused and (guard_on or clip is not None):
-            # nan_grad fault site; a fired injection invalidates any
-            # health computed during the allreduce
-            if numerics.maybe_inject_nan_grad(grads) or health is None:
+            # nan_grad / bit_flip_grad fault sites; a fired injection
+            # invalidates any health computed during the allreduce
+            from .. import integrity as _integrity
+
+            flipped = _integrity.maybe_bit_flip_grad(grads=grads)
+            if numerics.maybe_inject_nan_grad(grads) or flipped \
+                    or health is None:
                 health = numerics.grad_health(
                     [g._data if isinstance(g, NDArray) else g
                      for g in grads])
@@ -403,6 +413,30 @@ class Trainer:
         else:
             for i, g, w in updates:
                 self._updaters[0](i, g, w)
+
+    # -- integrity plane plumbing (mxnet_tpu/integrity.py) ---------------------
+
+    def attach_integrity(self, plane):
+        """Attach an `integrity.IntegrityPlane`: with MXTPU_INTEGRITY
+        on, the captured step fingerprints the parameter+optimizer
+        state every ``plane.every`` steps (in-program, read back with
+        the StepGuard's single sync) and attests it against the
+        plane's peers.  Returns self for chaining."""
+        self._integrity_plane = plane
+        return self
+
+    def _integrity_due(self):
+        """Does the step ABOUT to dispatch attest?  Read pre-dispatch
+        (the traced ``attest`` predicate of the captured program)."""
+        plane = self._integrity_plane
+        return plane is not None and plane.due(self._step_count + 1)
+
+    def _integrity_attest(self, fp):
+        """One attestation round for the step that just committed."""
+        plane = self._integrity_plane
+        if plane is None or fp is None:
+            return None
+        return plane.attest(self._step_count, fp)
 
     # -- numerical-health guard plumbing (mxnet_tpu/numerics.py) ---------------
 
@@ -442,6 +476,7 @@ class Trainer:
                 monitor.observe(step=self._step_count,
                                 grad_norm=guard.grad_norm, healthy=True)
             self._note_guard_scalars(guard, scaler)
+            self._integrity_attest(guard.fingerprint)
             return
         healthy = guard.healthy
         if not healthy:
@@ -464,6 +499,7 @@ class Trainer:
             monitor.observe(step=self._step_count,
                             grad_norm=guard.grad_norm, healthy=healthy)
         self._note_guard_scalars(guard, scaler)
+        self._integrity_attest(guard.fingerprint)
 
     def _note_guard_scalars(self, guard, scaler):
         """Attach guard scalars to the open StepStats record — only via

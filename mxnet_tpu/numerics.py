@@ -168,11 +168,13 @@ class StepGuard:
     `readback_count()`.
     """
 
-    def __init__(self, health, skip=True, clip=None):
+    def __init__(self, health, skip=True, clip=None, extra=None):
         self.health = health          # (2,) f32 device array
         self.skip = bool(skip)
         self.clip = None if clip is None else float(clip)
+        self.extra = extra            # (2,) u32 fingerprint (integrity)
         self._host = None
+        self._extra_host = None
 
     def _materialize(self):
         if self._host is None:
@@ -184,11 +186,30 @@ class StepGuard:
 
             # the step's ONE host sync: in a pipelined loop this span is
             # where the host waits out the device (bench.py reads it for
-            # the readback share of the step-time breakdown)
+            # the readback share of the step-time breakdown).  The
+            # integrity fingerprint (when the step computed one) rides
+            # the same transfer — attestation adds no extra sync.
             with profiler.annotate("guard_readback"):
-                v = _np.asarray(self.health)
+                if self.extra is not None:
+                    import jax
+
+                    v, e = jax.device_get((self.health, self.extra))
+                    self._extra_host = _np.asarray(e)
+                else:
+                    v = _np.asarray(self.health)
             self._host = (float(v[0]), float(v[1]))
         return self._host
+
+    @property
+    def fingerprint(self):
+        """The step's integrity fingerprint as one u64 int, or None
+        when the program computed none (integrity off / not an
+        attestation step).  Shares the single guard readback."""
+        if self.extra is None:
+            return None
+        self._materialize()
+        e = self._extra_host
+        return (int(e[1]) << 32) | int(e[0])
 
     def peek(self):
         """``(all_finite, global_sq_norm)`` if the host readback already

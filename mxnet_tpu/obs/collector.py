@@ -44,6 +44,10 @@ TIMELINE_EVENTS = (
     "scale_up_proposed", "scale_down_proposed", "serving_reload",
     "serving_replica_failover", "serving_replica_spawned",
     "profile_captured",
+    # integrity plane (integrity.py): corruption verdicts and the
+    # quarantine/repair around them belong on the fleet timeline
+    "sdc_detected", "integrity_mismatch", "rank_quarantined",
+    "replay_audit", "serving_reload_rejected",
 )
 
 _TIMELINE_MAX = 16     # events carried per rollup
@@ -111,6 +115,8 @@ class HostCollector:
         self._request_queue_us = 0.0
         self._steps_total = 0
         self._skipped_total = 0
+        self._attestations = 0
+        self._integrity_mismatches = 0
         self._last_profile_id = None
         self._stop = threading.Event()
         self._thread = None
@@ -133,6 +139,10 @@ class HostCollector:
             elif kind == "request":
                 self._requests += 1
                 self._request_queue_us += float(rec.get("queue_us", 0.0))
+            elif kind == "integrity":
+                self._attestations += 1
+                if not rec.get("ok", True):
+                    self._integrity_mismatches += 1
 
     def rollup(self) -> dict:
         """The bounded per-rank summary published to the control
@@ -168,6 +178,8 @@ class HostCollector:
             "request_queue_us_mean": round(
                 self._request_queue_us / self._requests, 1)
             if self._requests else None,
+            "attestations": self._attestations,
+            "integrity_mismatches": self._integrity_mismatches,
             "events": [self._event_brief(e) for e in self._events],
         }
         return out
@@ -177,7 +189,8 @@ class HostCollector:
         brief = {"event": e.get("event"), "t": e.get("t")}
         for k in ("rank", "world", "epoch", "step", "members",
                   "planned", "mean_collective_share", "laggard_step",
-                  "path", "steps", "generation"):
+                  "path", "steps", "generation", "kind", "corrupt",
+                  "reason"):
             if e.get(k) is not None:
                 brief[k] = e[k]
         return brief
@@ -372,6 +385,11 @@ class FleetView:
             "interval_skew": round(skew, 3) if skew else None,
             "slowest_rank": slowest,
             "stragglers": self._stragglers(),
+            "attestations": sum(rollups[r].get("attestations") or 0
+                                for r in ranks),
+            "integrity_mismatches": sum(
+                rollups[r].get("integrity_mismatches") or 0
+                for r in ranks),
             "timeline": timeline,
         }
 

@@ -73,6 +73,15 @@ Fault-injection sites (``MXTPU_FAULT_INJECT="site:arg,site:arg"``):
 - ``tune_oom:N``         — the next N autotune trials fail with a
                           simulated RESOURCE_EXHAUSTED (the infeasible-
                           point path, hermetic on CPU)
+- ``bit_flip_param:K``   — flip one bit in rank K's first parameter
+                          after a step commits (memory SDC; one-shot —
+                          integrity.py attestation must name rank K)
+- ``bit_flip_grad:K``    — flip one bit in rank K's first gradient
+                          before the update (eager path, nan_grad
+                          routing discipline)
+- ``bad_core:K``         — rank K's step input is perturbed so its
+                          compute is deterministically wrong (compute
+                          SDC; replay audit classifies it)
 
 Elastic gang recovery (PR 8) also lives here: :class:`HeartbeatPublisher`
 / :class:`FailureDetector` / :class:`StragglerMonitor` form the health
@@ -192,6 +201,19 @@ class _FaultPlan:
                 # while the process keeps running
                 self.list_args.setdefault(site, []).append(
                     int(arg) if arg else 0)
+            elif site in ("bit_flip_param", "bit_flip_grad",
+                          "bad_core"):
+                # silent-data-corruption sites (integrity.py): rank-
+                # targeted like kill_rank, but ONE-SHOT per listed rank
+                # — bit_flip_param:K flips one bit in rank K's first
+                # parameter after a step commits (memory SDC);
+                # bit_flip_grad:K flips one bit in a gradient before
+                # the update (eager path only, nan_grad routing);
+                # bad_core:K perturbs rank K's step input so its
+                # compute is deterministically wrong (compute SDC)
+                r = int(arg) if arg else 0
+                self.list_args.setdefault(site, []).append(r)
+                self.counts[f"{site}:{r}"] = 1
             elif site in ("stall_collective", "stall"):
                 self.args["stall_collective"] = float(arg) if arg else 3600.0
                 self.counts["stall_collective"] = 1
@@ -269,9 +291,50 @@ def fault_armed(site):
     consume).  Lets a fast path that cannot express a site's fault —
     e.g. the captured train step, whose gradients never materialize for
     ``nan_grad`` poisoning — route the affected step to the path that
-    can."""
+    can.  Rank-targeted sites keep their one-shot charges under
+    ``site:rank`` keys — armed while ANY listed rank's charge is
+    unspent."""
     plan = _plan()
-    return plan is not None and plan.counts.get(site, 0) > 0
+    if plan is None:
+        return False
+    if plan.counts.get(site, 0) > 0:
+        return True
+    prefix = site + ":"
+    return any(v > 0 for k, v in plan.counts.items()
+               if k.startswith(prefix))
+
+
+def consume_rank_fault(site, rank):
+    """One-shot rank-targeted charge: True exactly once for each rank
+    listed on the site (``bit_flip_param:1`` fires once on rank 1,
+    never again, never on anyone else).  The per-rank charge lives in
+    the same counter table as counted sites, keyed ``site:rank``."""
+    if rank not in fault_args(site):
+        return False
+    plan = _plan()
+    return plan is not None and plan.consume(f"{site}:{int(rank)}")
+
+
+def consume_charges(site, on_last=True):
+    """Shared charge-consumption semantics for counted sites.
+
+    Consumes ONE charge of ``site`` (when any remain) and reports
+    whether the fault should FIRE now:
+
+    - ``on_last=True`` (kill_coordinator semantics, the PR 11 off-by
+      fix): the fault fires on the LAST charge only — ``site:N`` means
+      "survive N-1 occurrences, die on the Nth".  Returns True when
+      the charge just consumed was the final one.
+    - ``on_last=False`` (corrupt_ckpt_write / corrupt_shard
+      semantics): every charge fires — ``site:N`` corrupts the next N
+      occurrences.  Returns True for each consumed charge.
+    """
+    plan = _plan()
+    if plan is None or not plan.consume(site):
+        return False
+    if not on_last:
+        return True
+    return plan.counts.get(site, 0) <= 0
 
 
 #: exit code of an injected hard crash (``crash_during_save`` /
@@ -671,7 +734,7 @@ class LocalCheckpointer:
             # durability: the rename lives in the directory inode — fsync
             # it too, or power loss can roll the commit back
             fsync_dir(self._dir)
-        if consume_fault("corrupt_ckpt_write"):
+        if consume_charges("corrupt_ckpt_write", on_last=False):
             # bit-rot the file AFTER the commit rename: only the
             # verify-after-write readback (_save_verified) can catch it
             with open(self._path(step), "r+b") as f:

@@ -237,9 +237,30 @@ class ServingEngine:
                 f"(heads {H} vs {self._H}, act {act!r} vs {self._act!r})")
         self._swap(stacks, lnf, tok, pos, step=step)
 
-    def reload_from_state(self, state, step=None):
+    def reload_from_state(self, state, step=None, expect_fp=None):
         """Swap in weights from an AsyncCheckpointer state dict
-        (``state_for_serving`` convention)."""
+        (``state_for_serving`` convention).
+
+        ``expect_fp``: optional integrity fingerprint (u64, the
+        training side's attested `integrity.fingerprint_host` of this
+        state).  When given, the state is re-fingerprinted here and a
+        mismatch REJECTS the reload (``serving_reload_rejected``)
+        instead of serving corrupt weights — end-to-end coverage of
+        the restore path itself, past the per-shard CRCs."""
+        if expect_fp is not None:
+            from .. import integrity, telemetry
+
+            got = integrity.fingerprint_host(state)
+            if got != int(expect_fp):
+                telemetry.event(
+                    "serving_reload_rejected", step=step,
+                    reason=f"state fingerprint {integrity.fp_hex(got)} "
+                           f"!= attested "
+                           f"{integrity.fp_hex(int(expect_fp))}")
+                raise MXNetError(
+                    "serving reload: restored state fingerprint does "
+                    "not match the attested fingerprint — refusing to "
+                    "serve corrupt weights")
         stacks, lnf, tok, pos = _stacks_from_state(state)
         self._swap(stacks, lnf, tok, pos, step=step)
 

@@ -79,9 +79,9 @@ class ReplicaServer:
     # -- hot reload ------------------------------------------------------------
 
     def poll_once(self):
-        """Check the manifest; restore + stage a newer step.  Runs on
-        the poller thread — the expensive host restore happens here,
-        never on the serving thread."""
+        """Check the manifest; verify, restore + stage a newer step.
+        Runs on the poller thread — the expensive host restore happens
+        here, never on the serving thread."""
         from .. import checkpoint
 
         step = checkpoint.latest_manifest_step(self._ckpt_dir)
@@ -92,10 +92,39 @@ class ReplicaServer:
             return False
         ck = checkpoint.AsyncCheckpointer(
             self._ckpt_dir, rank=0, world_size=1)
+        if not self._verify_reload(ck, step):
+            # a corrupt checkpoint is REJECTED, never served; the step
+            # still dedups (a bad file on disk will not un-corrupt —
+            # without this the poller would re-verify it every 200ms
+            # forever).  A subsequent GOOD step reloads normally.
+            self._fetched_step = step
+            return False
         state = ck.restore(step=step)
         self._fetched_step = step
         with self._staged_lock:
             self._staged = (step, state)
+        return True
+
+    def _verify_reload(self, ck, step):
+        """Integrity gate ahead of the swap: re-read the manifest with
+        every shard CRC-checked, and audit its attestation-ledger stamp
+        (integrity.verify_provenance) when one is present.  Emits
+        ``serving_reload_rejected`` and returns False on any failure."""
+        from .. import integrity
+        from ..resilience import CheckpointCorrupt
+
+        try:
+            m = ck.verify(step)
+        except CheckpointCorrupt as exc:
+            telemetry.event("serving_reload_rejected", rank=self.rank,
+                            step=int(step), reason=str(exc)[:200])
+            return False
+        ok, why = integrity.verify_provenance(m)
+        if not ok:
+            telemetry.event("serving_reload_rejected", rank=self.rank,
+                            step=int(step),
+                            reason=f"provenance: {why}"[:200])
+            return False
         return True
 
     def _poll_loop(self):

@@ -51,8 +51,11 @@ _LOCK = threading.Lock()
 # / ``replica_id`` identity fields, and request records may carry a
 # ``trace_id`` plus a closed ``spans`` tree (obs/spans.py); v1/v2
 # records stay valid.
-SCHEMA_VERSION = 3
-_ACCEPTED_VERSIONS = (1, 2, 3)
+# v4 (integrity plane): new ``integrity`` record type — one attestation
+# round per record: {step, fp, ok} plus optional {epoch, peers,
+# corrupt, kind}; v1/v2/v3 records stay valid.
+SCHEMA_VERSION = 4
+_ACCEPTED_VERSIONS = (1, 2, 3, 4)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -427,6 +430,39 @@ def _emit(record):
 _TAILS = {}           # path -> {"off", "ino", "r1_off"}
 _TAIL_RINGS = {}      # path -> bounded list of parsed records
 _TAIL_BYTES = 0       # total bytes read by _read_lines (test pin)
+_TAIL_STRIKES = {}    # path -> [tail_start, tail_len, polls_held]
+
+
+def _tail_strikes_max(default=3) -> int:
+    """MXTPU_TELEMETRY_TAIL_STRIKES: polls the SAME half-flushed tail
+    may be held back before it is skipped as torn (default 3)."""
+    try:
+        v = int(os.environ.get("MXTPU_TELEMETRY_TAIL_STRIKES", default))
+    except ValueError:
+        v = default
+    return max(2, v)
+
+
+def _tail_strike(path, tail_start, tail_len, new_off):
+    """Torn-tail strike accounting.  A half-flushed line is normally
+    held back (re-read next poll) until its newline lands — but a line
+    that NEVER completes (writer died mid-append, bit-rot ate the
+    newline) would otherwise wedge the tail forever, silently.  After
+    the identical byte range is held back ``_tail_strikes_max()``
+    polls in a row, skip past it and emit one ``telemetry_torn_line``
+    event so the corruption is visible.  A growing tail (len changes)
+    resets the count — only a genuinely stuck line strikes out."""
+    st = _TAIL_STRIKES.get(path)
+    if st is not None and st[0] == tail_start and st[1] == tail_len:
+        st[2] += 1
+    else:
+        st = _TAIL_STRIKES[path] = [tail_start, tail_len, 1]
+    if st[2] < _tail_strikes_max():
+        return new_off
+    del _TAIL_STRIKES[path]
+    event("telemetry_torn_line", path=os.path.basename(path),
+          offset=int(tail_start), bytes=int(tail_len))
+    return tail_start + tail_len
 
 
 def tail_bytes_read() -> int:
@@ -450,14 +486,20 @@ def _read_lines(path, start):
     _TAIL_BYTES += len(data)
     nl = data.rfind(b"\n")
     if nl < 0:
-        return [], start
+        return [], _tail_strike(path, start, len(data), start)
     recs = []
     for raw in data[:nl + 1].splitlines():
         try:
             recs.append(json.loads(raw))
         except ValueError:
             pass               # torn line mid-file (crash artifact)
-    return recs, start + nl + 1
+    new_off = start + nl + 1
+    tail = len(data) - (nl + 1)
+    if tail:
+        new_off = _tail_strike(path, new_off, tail, new_off)
+    else:
+        _TAIL_STRIKES.pop(path, None)
+    return recs, new_off
 
 
 def tail_records(path):
@@ -553,6 +595,7 @@ def reset(close_sink=True):
     _IDENT = None
     _TAILS.clear()
     _TAIL_RINGS.clear()
+    _TAIL_STRIKES.clear()
     _TAIL_BYTES = 0
     _SINK_SIZE = 0
     if close_sink and _SINK is not None:
@@ -563,9 +606,11 @@ def reset(close_sink=True):
         _SINK = None
 
 
-def event(kind, **fields):
+def event(kind, /, **fields):
     """Emit one discrete, run-id-stamped event record (watchdog fired,
-    step skipped, divergence rollback, restart, checkpoint commit)."""
+    step skipped, divergence rollback, restart, checkpoint commit).
+    The event name is positional-only so a detail field may itself be
+    named ``kind`` (e.g. sdc_detected's corruption class)."""
     if not enabled():
         return
     rec = {"type": "event", "v": SCHEMA_VERSION, "run": _RUN_ID,
@@ -599,6 +644,36 @@ def request_record(queue_us, prefill_us, decode_us_per_token, bucket,
         rec["new_tokens"] = int(new_tokens)
     if generation is not None:
         rec["generation"] = int(generation)
+    for k, v in fields.items():
+        if v is not None:
+            rec[k] = v
+    _emit(rec)
+
+
+def integrity_record(step, fp, ok, epoch=None, peers=None, corrupt=None,
+                     kind=None, rank=None, **fields):
+    """Emit one integrity-attestation record (schema v4): the
+    fingerprint this rank published for ``step``, whether the
+    cross-replica vote agreed (``ok``), how many peers voted, which
+    ranks the majority named corrupt, and — after a replay audit — the
+    corruption ``kind`` ("memory" | "compute" | "drift").
+    tools/trace_report.py and the obs collector aggregate these into
+    the integrity section."""
+    if not enabled():
+        return
+    rec = {"type": "integrity", "v": SCHEMA_VERSION, "run": _RUN_ID,
+           "t": time.time(), "step": int(step), "fp": str(fp),
+           "ok": bool(ok)}
+    if epoch is not None:
+        rec["epoch"] = int(epoch)
+    if peers is not None:
+        rec["peers"] = int(peers)
+    if corrupt:
+        rec["corrupt"] = [int(r) for r in corrupt]
+    if kind is not None:
+        rec["kind"] = str(kind)
+    if rank is not None:
+        rec["rank"] = int(rank)
     for k, v in fields.items():
         if v is not None:
             rec[k] = v
@@ -982,8 +1057,9 @@ def validate_record(rec):
     if not isinstance(rec, dict):
         fail("not an object")
     kind = rec.get("type")
-    if kind not in ("step", "event", "request"):
-        fail(f"type must be 'step'|'event'|'request', got {kind!r}")
+    if kind not in ("step", "event", "request", "integrity"):
+        fail(f"type must be 'step'|'event'|'request'|'integrity', "
+             f"got {kind!r}")
     if not isinstance(rec.get("run"), str) or not rec["run"]:
         fail("missing run id")
     if not isinstance(rec.get("t"), (int, float)):
@@ -1030,6 +1106,34 @@ def validate_record(rec):
         step = rec.get("step")
         if step is not None and not isinstance(step, int):
             fail("event step must be an int")
+        return rec
+    if kind == "integrity":
+        # schema v4: one attestation round
+        step = rec.get("step")
+        if not isinstance(step, int) or isinstance(step, bool) or \
+                step < 0:
+            fail("integrity step must be a non-negative int")
+        fp = rec.get("fp")
+        if not isinstance(fp, str) or not fp:
+            fail("integrity fp must be a non-empty string")
+        if not isinstance(rec.get("ok"), bool):
+            fail("integrity ok must be a bool")
+        for key in ("epoch", "peers"):
+            val = rec.get(key)
+            if val is not None and (not isinstance(val, int) or
+                                    isinstance(val, bool) or val < 0):
+                fail(f"integrity {key} must be a non-negative int "
+                     f"or absent")
+        corrupt = rec.get("corrupt")
+        if corrupt is not None and not (
+                isinstance(corrupt, list) and
+                all(isinstance(r, int) and not isinstance(r, bool)
+                    and r >= 0 for r in corrupt)):
+            fail("integrity corrupt must be a list of ranks or absent")
+        ik = rec.get("kind")
+        if ik is not None and ik not in ("memory", "compute", "drift"):
+            fail(f"integrity kind must be memory|compute|drift, "
+                 f"got {ik!r}")
         return rec
     if rec.get("step") is not None and not isinstance(rec["step"], int):
         fail("step must be an int or null")

@@ -546,3 +546,63 @@ def test_serve_cli_smoke():
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "served 4 requests" in proc.stdout
     assert "retraces_after_warmup 0" in proc.stdout
+
+
+# -- reload integrity gate (mxnet_tpu/integrity.py) ----------------------------
+
+def test_reload_rejects_corrupt_checkpoint_and_keeps_serving(tmp_path):
+    """A bit-rotted shard must never be swapped in: the poller's
+    verify-before-stage gate (per-shard CRC + provenance audit) rejects
+    the step ONCE (rejection dedups — a bad file will not un-corrupt),
+    emits ``serving_reload_rejected``, and the replica keeps serving on
+    its compiled-in weights."""
+    telemetry.reset()
+    model = _model(seed=1)
+    ck = checkpoint.AsyncCheckpointer(tmp_path, rank=0, world_size=1)
+    ck.save(1, serving.state_for_serving(model))
+    ck.wait()
+    ck.close()
+    sdir = next(p for p in tmp_path.iterdir()
+                if p.name.startswith("step_"))
+    shard = next(p for p in sdir.iterdir()
+                 if p.name.startswith("shard_"))
+    raw = bytearray(shard.read_bytes())
+    raw[len(raw) // 2] ^= 0x40
+    shard.write_bytes(bytes(raw))
+
+    eng = serving.ServingEngine(model, batch_buckets=(1, 2))
+    rs = ReplicaServer(eng, ckpt_dir=tmp_path, poll_ms=10,
+                       max_delay_ms=1)
+    try:
+        deadline = time.monotonic() + 30
+        while not telemetry.event_counts().get("serving_reload_rejected"):
+            assert time.monotonic() < deadline, "rejection never surfaced"
+            time.sleep(0.01)
+        time.sleep(0.2)                 # many more poll cycles
+        assert rs.loaded_step is None and rs.reloads == 0
+        assert telemetry.event_counts()["serving_reload_rejected"] == 1
+        # the replica is still healthy on its original weights
+        r = rs.submit(_prompts(1, np.random.RandomState(3))[0], 3)\
+            .result(timeout=120)
+        assert len(r["tokens"]) == 3
+    finally:
+        rs.close()
+    telemetry.reset()
+
+
+def test_reload_from_state_enforces_attested_fingerprint():
+    """``expect_fp`` closes the loop past the per-shard CRCs: the
+    restored state is re-fingerprinted and a mismatch with the
+    training side's attested fingerprint refuses the swap."""
+    from mxnet_tpu import integrity
+
+    telemetry.reset()
+    eng = serving.ServingEngine(_model(seed=1), batch_buckets=(1, 2))
+    state = serving.state_for_serving(_model(seed=2))
+    with pytest.raises(MXNetError, match="fingerprint"):
+        eng.reload_from_state(state, step=2, expect_fp=12345)
+    assert telemetry.event_counts().get("serving_reload_rejected") == 1
+    # the attested fingerprint of the same state swaps cleanly
+    eng.reload_from_state(state, step=2,
+                          expect_fp=integrity.fingerprint_host(state))
+    telemetry.reset()

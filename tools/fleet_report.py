@@ -45,7 +45,10 @@ TIMELINE_KINDS = ("mesh_reshape", "rank_drained", "rank_dead",
                   "scale_down", "gang_drain_scheduled", "chips_freed",
                   "straggler_suspected", "resume", "restart",
                   "serving_reload", "serving_replica_failover",
-                  "serving_replica_spawned", "profile_captured")
+                  "serving_replica_spawned", "profile_captured",
+                  "sdc_detected", "integrity_mismatch",
+                  "rank_quarantined", "replay_audit",
+                  "serving_reload_rejected")
 
 
 def expand_paths(args_paths):
@@ -118,12 +121,15 @@ def rank_stats(records):
     shares = {}
     for k in BREAKDOWN_KEYS:
         shares[k] = _mean([s.get("shares", {}).get(k) for s in steps])
+    integ = [r for r in records if r.get("type") == "integrity"]
     return {
         "steps": len(steps),
         "interval_us": _mean([s.get("interval_us") for s in steps]),
         "mfu": _mean([s.get("mfu") for s in steps]),
         "shares": shares,
         "requests": sum(1 for r in records if r.get("type") == "request"),
+        "attestations": len(integ),
+        "integrity_mismatches": sum(1 for r in integ if not r.get("ok")),
     }
 
 
@@ -145,6 +151,11 @@ def report_fleet_summary(ranks, out):
             den += s["steps"]
     if den:
         out.write(f"fleet mfu (step-weighted): {num / den:.5f}\n")
+    attest = sum(s["attestations"] for s in stats.values())
+    if attest:
+        mism = sum(s["integrity_mismatches"] for s in stats.values())
+        out.write(f"integrity: {attest} attestation(s), "
+                  f"{mism} mismatch(es)\n")
     if train:
         out.write("per-rank breakdown (mean share of step interval):\n")
         hdr = (f"  {'rank':>6}{'steps':>7}{'interval_us':>13}"
@@ -215,7 +226,7 @@ def report_timeline(records, out):
         who = f" [rank {e['rank']}]" if e.get("rank") is not None else ""
         detail = []
         for k in ("epoch", "world", "members", "step", "planned",
-                  "generation", "path", "steps"):
+                  "generation", "path", "steps", "kind", "corrupt"):
             if e.get(k) is not None:
                 detail.append(f"{k}={e[k]}")
         out.write(f"  +{e['t'] - t0:8.2f}s  {e['event']}{who}"

@@ -95,9 +95,13 @@ def report_run(run, records, out):
     steps = [s for s in all_steps if not s.get("tuning_trial")]
     events = [r for r in records if r.get("type") == "event"]
     requests = [r for r in records if r.get("type") == "request"]
+    attestations = [r for r in records if r.get("type") == "integrity"]
     out.write(f"run {run}: {len(steps)} step records"
               + (f" (+{len(trials)} tuning trials)" if trials else "")
-              + f", {len(events)} events, {len(requests)} requests\n")
+              + f", {len(events)} events, {len(requests)} requests"
+              + (f", {len(attestations)} attestations"
+                 if attestations else "")
+              + "\n")
     if requests:
         report_requests(requests, out)
     if steps:
@@ -141,10 +145,47 @@ def report_run(run, records, out):
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
+        report_integrity(kinds, attestations, out)
         report_fleet(kinds, requests, out)
         report_autotune(kinds, trials, out)
-    elif trials:
-        report_autotune({}, trials, out)
+    else:
+        if attestations:
+            report_integrity({}, attestations, out)
+        if trials:
+            report_autotune({}, trials, out)
+
+
+def report_integrity(kinds, attestations, out):
+    """Integrity-plane section: attestation rounds, cross-replica
+    mismatches, replay-audit verdicts, and quarantines.  Prints
+    nothing when the run never attested and saw no SDC events."""
+    integ_kinds = ("sdc_detected", "integrity_mismatch", "replay_audit",
+                   "rank_quarantined", "serving_reload_rejected")
+    if not attestations and not any(k in kinds for k in integ_kinds):
+        return
+    out.write("  integrity:\n")
+    if attestations:
+        bad = [a for a in attestations if not a.get("ok")]
+        out.write(f"    attestations: {len(attestations)} "
+                  f"({len(bad)} mismatched)\n")
+    for e in kinds.get("integrity_mismatch", ()):
+        out.write(f"    mismatch: step {e.get('step', '?')} corrupt "
+                  f"rank(s) {e.get('corrupt', '?')} "
+                  f"({e.get('votes', '?')} votes)\n")
+    for e in kinds.get("sdc_detected", ()):
+        out.write(f"    sdc: rank {e.get('rank', '?')} at step "
+                  f"{e.get('step', '?')} kind "
+                  f"{e.get('kind', '?')}\n")
+    for e in kinds.get("replay_audit", ()):
+        out.write(f"    replay audit: rank {e.get('rank', '?')} step "
+                  f"{e.get('step', '?')} -> {e.get('kind', '?')}\n")
+    for e in kinds.get("rank_quarantined", ()):
+        out.write(f"    quarantined: rank {e.get('rank', '?')} "
+                  f"(epoch {e.get('epoch', '?')}, step "
+                  f"{e.get('step', '?')})\n")
+    for e in kinds.get("serving_reload_rejected", ()):
+        out.write(f"    serving reload rejected: step "
+                  f"{e.get('step', '?')} ({e.get('reason', '?')})\n")
 
 
 def report_autotune(kinds, trials, out):
