@@ -229,6 +229,22 @@ def _sharded_attempts(tpu_ok):
     return attempts
 
 
+def _pp_attempts(tpu_ok):
+    steps = int(os.environ.get("BENCH_PP_STEPS", 10))
+    cfg = {"model": "pp_step", "batch": 8, "steps": steps}
+    attempts = []
+    if tpu_ok:
+        attempts.append((None, dict(cfg, backend="tpu"), 300))
+    # forced-host 8-device mesh: the SAME 3-axis program (tp
+    # collectives, pp stage hand-offs, dp reduce) compiles and runs on
+    # any box; the orchestrator tags the numbers pp_on_chip_unavailable
+    attempts.append((
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        dict(cfg, backend="cpu"), 300))
+    return attempts
+
+
 def _autotune_attempts(tpu_ok):
     steps = int(os.environ.get("BENCH_TUNE_TIMED_STEPS", 20))
     cfg = {"model": "autotune", "batch": 8, "steps": steps}
@@ -1017,6 +1033,13 @@ def orchestrate():
             sharded = _run_worker(env_over, cfg, budget, sharded_errors)
             if sharded is not None:
                 break
+    pp = None
+    pp_errors = []
+    if headline is not None and not os.environ.get("BENCH_SKIP_PP"):
+        for env_over, cfg, budget in _pp_attempts(tpu_ok):
+            pp = _run_worker(env_over, cfg, budget, pp_errors)
+            if pp is not None:
+                break
     autotune = None
     autotune_errors = []
     if headline is not None \
@@ -1189,6 +1212,27 @@ def orchestrate():
             }
     elif sharded_errors:
         headline["sharded_error"] = "; ".join(sharded_errors)[-300:]
+    if pp is not None:
+        headline["pp_step_us"] = pp["value"]
+        headline["pp_tp_only_step_us"] = pp.get("tp_only_step_us")
+        headline["pp_bubble_fraction"] = pp.get("bubble_fraction")
+        headline["pp_collective_bytes_by_axis"] = \
+            pp.get("pp_collective_bytes_by_axis")
+        headline["pp_mesh"] = pp.get("pp_mesh")
+        headline["pp_gates"] = pp.get("pp_gates")
+        headline["pp_gates_ok"] = pp.get("pp_gates_ok")
+        # forced-host mesh numbers survive only tagged, never as an
+        # on-chip result (sharded_on_chip_unavailable discipline)
+        if pp.get("backend") == "cpu":
+            headline["pp_on_chip_unavailable"] = {
+                "reason": probe_note if not tpu_ok
+                else "tpu attempts failed; cpu fallback produced the "
+                     "pipeline numbers",
+                "fallback_backend": "cpu",
+                "numbers_are_cpu": True,
+            }
+    elif pp_errors:
+        headline["pp_error"] = "; ".join(pp_errors)[-300:]
     if autotune is not None:
         headline["autotune_tuned_step_us"] = autotune["value"]
         headline["autotune_default_step_us"] = autotune.get("default_us")
@@ -1614,6 +1658,8 @@ def worker(cfg):
         bench_ckpt(cfg, devices)
     elif cfg["model"] == "sharded_step":
         bench_sharded(cfg, devices)
+    elif cfg["model"] == "pp_step":
+        bench_pp(cfg, devices)
     elif cfg["model"] == "autotune":
         bench_autotune(cfg, devices)
     elif cfg["model"] == "serving":
@@ -2340,6 +2386,117 @@ def bench_sharded(cfg, devices):
         "fsdp_mesh": fsdp_out["mesh"],
         "tp_dispatches": tp_out["dispatches"],
         "fsdp_dispatches": fsdp_out["dispatches"],
+        "steps": steps,
+        "batch": batch,
+        "backend": devices[0].platform,
+    }))
+
+
+def bench_pp(cfg, devices):
+    """pp_step_us: 3-axis (tp×pp×dp) vs tp-only full train-step latency
+    at EQUAL global batch on a scanned-trunk transformer, with the 1F1B
+    microbatch schedule fused into the ONE donated whole-step program
+    (gluon/captured.py; docs/parallel.md "Pipeline parallelism on the
+    captured step").  Per point: the measured ``bubble_fraction`` from
+    the StepStats records the timed loop emits and per-axis collective
+    bytes (the ``pp`` row is the stage grad hand-off).  Gates, same
+    discipline as trainer_gates:
+
+    - pp_zero_retrace: the schedule lives INSIDE the cached program —
+      the timed loop must be all cache hits, zero retraces, one
+      dispatch per step;
+    - bubble_share_reported: the schedule accounts for its own bubble
+      in telemetry (docs/observability.md, schema v5)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel, telemetry
+    from mxnet_tpu.gluon import captured
+    from mxnet_tpu.gluon.model_zoo.bert import ScanTransformerEncoder
+
+    steps = cfg["steps"]
+    n = max(1, len(devices))
+    if n % 4 != 0:
+        raise RuntimeError(
+            "pp bench needs a device count divisible by 4 for the "
+            "tp=2 x pp=2 x dp mesh, got %d" % n)
+    units, hidden, layers, batch, t = 64, 256, 4, cfg["batch"], 6
+
+    rng = np.random.RandomState(0)
+    x_np = rng.normal(size=(batch, t, units)).astype(np.float32)
+    y_np = rng.randint(0, units, size=(batch, t)).astype(np.float32)
+
+    def _run_mode(mode, mesh_axes):
+        mesh = parallel.make_mesh(axes=dict(mesh_axes))
+        mx.random.seed(7)
+        net = ScanTransformerEncoder(num_layers=layers, units=units,
+                                     num_heads=4, hidden_size=hidden,
+                                     dropout=0.0)
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        parallel.shard_model(net, mesh, mode=mode)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        loss_fn.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+
+        def step():
+            return tr.train_step(net, loss_fn, mx.nd.array(x_np),
+                                 mx.nd.array(y_np))
+
+        _readback(step())
+        _readback(step())
+        captured.reset_counters()
+        telemetry.reset()
+        dt, _ = _timed_loop(step, steps, per_step_readback=True)
+        recs = [r for r in telemetry.recent_steps()
+                if r.get("path") == "captured"][-steps:]
+        bubble = coll = None
+        for r in reversed(recs):
+            if bubble is None and r.get("bubble_fraction") is not None:
+                bubble = r["bubble_fraction"]
+            if coll is None and r.get("collective_bytes_by_axis"):
+                coll = r["collective_bytes_by_axis"]
+        cache = captured.cache_stats()
+        out = {
+            "step_us": round(dt / steps * 1e6, 1),
+            "bubble_fraction": bubble,
+            "collective_bytes_by_axis": coll,
+            "dispatches": captured.dispatch_count(),
+            "traces": captured.trace_count(),
+            "cache_misses": cache.get("misses"),
+            "mesh": dict(mesh_axes),
+        }
+        parallel.set_default_mesh(None)
+        return out
+
+    tp_out = _run_mode("tp", {"dp": n // 2, "tp": 2})
+    pp_out = _run_mode("tp_pp", {"tp": 2, "pp": 2, "dp": n // 4})
+
+    bubble = pp_out["bubble_fraction"]
+    gates = {
+        "pp_zero_retrace": pp_out["traces"] == 0
+        and pp_out["cache_misses"] == 0
+        and pp_out["dispatches"] == steps,
+        "bubble_share_reported": bubble is not None
+        and 0 <= bubble < 1,
+    }
+    print(json.dumps({
+        "metric": "pp_step_us",
+        "value": pp_out["step_us"],
+        "unit": "us/step",
+        "vs_baseline": None,
+        "tp_only_step_us": tp_out["step_us"],
+        "bubble_fraction": bubble,
+        "pp_collective_bytes_by_axis":
+            pp_out["collective_bytes_by_axis"],
+        "tp_collective_bytes_by_axis":
+            tp_out["collective_bytes_by_axis"],
+        "pp_mesh": pp_out["mesh"],
+        "tp_mesh": tp_out["mesh"],
+        "pp_dispatches": pp_out["dispatches"],
+        "pp_gates": gates,
+        "pp_gates_ok": all(gates.values()),
         "steps": steps,
         "batch": batch,
         "backend": devices[0].platform,

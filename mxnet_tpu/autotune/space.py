@@ -112,6 +112,16 @@ register(Knob(
     doc="max params fused per optimizer group, 0 = unlimited "
         "(optimizer/grouped.plan_items)"))
 register(Knob(
+    "pp_microbatches", "MXTPU_PP_MICROBATCHES",
+    ("0", "1", "2", "4", "8"), "0", layer="program",
+    numerics_preserving=False,
+    doc="microbatches per pipeline stage-pass in the captured 1F1B "
+        "schedule, 0 = auto (the mesh's pp size); program-affecting — "
+        "folded into the capture-cache key (gluon/captured.py).  Like "
+        "grad_accum it CHANGES update math for the same global batch "
+        "(captured(k, m) matches the eager oracle at grad_accum=k*m), "
+        "so the search touches it only with MXTPU_TUNE_SEMANTICS=1"))
+register(Knob(
     "grad_accum", "MXTPU_GRAD_ACCUM",
     ("1", "2", "4"), "1", layer="schedule",
     numerics_preserving=False,

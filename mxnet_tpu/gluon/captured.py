@@ -20,6 +20,17 @@ loss-scale changes never retrace.  ``grad_accum=k`` becomes a
 aux state threaded through the carry exactly as the eager path writes
 it back between microbatches.
 
+Pipeline parallelism (PR 17) lives INSIDE the same program: when the
+parameters sit on a mesh with a ``pp`` axis (PPRules claims the scanned
+trunk's leading layer-stack dim), the grad-accum scan is restructured
+into a 1F1B-style shifted-carry schedule over ``grad_accum ×
+pp_microbatches`` slices — each tick drains the previous microbatch's
+gradients (handed to their stages with `with_sharding_constraint` on
+the pp axis) while the current microbatch's stages compute, letting XLA
+overlap cross-stage traffic with compute.  Still ONE donated jit, one
+dispatch + one readback per step; ``MXTPU_PP=0`` or pp=1 degenerates to
+the flat scan byte-for-byte.
+
 Bitwise-parity discipline (PR 2/4): the eager multi-dispatch path stays
 as the oracle behind ``MXTPU_CAPTURED_STEP=0``.  The captured trace
 re-uses the exact same math homes — `block.param_override_scope` +
@@ -56,6 +67,49 @@ def captured_step_enabled() -> bool:
     `Trainer.train_step` to the eager multi-dispatch oracle."""
     return os.environ.get("MXTPU_CAPTURED_STEP", "1").lower() \
         not in ("0", "false", "off", "")
+
+
+def pp_enabled() -> bool:
+    """MXTPU_PP gate (default on); 0/false/off keeps the captured step
+    on the flat grad-accum scan even when the mesh has a pp axis — the
+    degenerate path is byte-identical to the pre-pipeline program."""
+    return os.environ.get("MXTPU_PP", "1").lower() \
+        not in ("0", "false", "off", "")
+
+
+def resolve_pp_schedule(mesh, grad_accum, batch):
+    """(pp_stages, pp_microbatches, total_slices) for this step.
+
+    The 1F1B schedule is active only when the params sit on a mesh with
+    a pp axis of size > 1 AND `pp_enabled()`; otherwise (1, 1, k) — the
+    flat grad-accum scan.  ``pp_microbatches`` comes from the autotune
+    knob (MXTPU_PP_MICROBATCHES; 0 = auto = the stage count), and the
+    total slice count n = k*m must divide the batch: unlike the silent
+    eager fallback for a batch indivisible by ``grad_accum`` alone, an
+    indivisible microbatch split is a configuration the user asked for
+    explicitly, so it raises UP FRONT naming both knobs.
+    """
+    k = int(grad_accum)
+    stages = 1 if mesh is None else int(mesh.shape.get("pp", 1))
+    if stages <= 1 or not pp_enabled():
+        return 1, 1, k
+    from ..autotune import space as _tune_space
+
+    knob = _tune_space.KNOBS.get("pp_microbatches")
+    try:
+        m = int(knob.current()) if knob is not None else 0
+    except ValueError:
+        m = 0
+    if m <= 0:
+        m = stages
+    n = k * m
+    if batch % n != 0:
+        raise ValueError(
+            f"pipeline schedule: batch {batch} is not divisible by "
+            f"grad_accum ({k}) * pp_microbatches ({m}) = {n} slices — "
+            "pick grad_accum / MXTPU_PP_MICROBATCHES whose product "
+            "divides the batch, or set MXTPU_PP=0")
+    return stages, m, n
 
 
 # -- accounting (regression-tested) --------------------------------------------
@@ -313,6 +367,11 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     from ..autotune import space as _tune_space
 
     remat_policy = _remat.env_default(dict(block._flags).get("remat"))
+    # pipeline schedule: raises (does NOT fall back) on an indivisible
+    # grad_accum × pp_microbatches split; n_micro lands in the key both
+    # directly and via mesh_fp + the pp_microbatches program knob
+    pp_stages, _pp_m, n_micro = resolve_pp_schedule(
+        mesh, k, int(data.shape[0]))
     key = (
         id(block), _tree_version(block),
         id(loss_fn), _tree_version(loss_fn),
@@ -323,6 +382,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
         None if label is None else (tuple(label.shape),
                                     str(_raw(label).dtype)),
         _kvs.device_fingerprint(), mesh_fp,
+        pp_stages, n_micro,
         remat_policy, _tune_space.program_knob_values(),
         # integrity attestation adds a program output (the state
         # fingerprint) — a toggled flag must re-capture, and the
@@ -342,7 +402,8 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
                         guard_on=guard_on, clip=clip,
                         has_scaler=has_scaler, grad_accum=k,
                         has_label=label is not None, mesh=mesh,
-                        remat=remat_policy)
+                        remat=remat_policy, pp_stages=pp_stages,
+                        n_micro=n_micro)
     cap = capture_cache_size()
     while len(cache) >= cap:
         evicted_key = next(iter(cache))
@@ -372,7 +433,7 @@ class CapturedStep:
 
     def __init__(self, trainer, block, loss_fn, trained, groups,
                  guard_on, clip, has_scaler, grad_accum, has_label,
-                 mesh=None, remat=None):
+                 mesh=None, remat=None, pp_stages=1, n_micro=None):
         # resolved remat policy (remat.py registry): checkpoint-style
         # policies wrap the per-microbatch forward+loss closure below;
         # 'save_every_k:N' instead applies inside the scanned trunk
@@ -393,6 +454,11 @@ class CapturedStep:
         self._want_guard = bool(guard_on) or clip is not None
         self._has_scaler = bool(has_scaler)
         self._grad_accum = int(grad_accum)
+        # 1F1B pipeline schedule (resolve_pp_schedule): total microbatch
+        # slices the in-program scan runs over — grad_accum *
+        # pp_microbatches when the mesh has a pp axis, else grad_accum
+        self._pp_stages = int(pp_stages)
+        self._n_micro = int(n_micro) if n_micro else int(grad_accum)
         self._has_label = bool(has_label)
         from . import block as _blockmod
 
@@ -436,7 +502,8 @@ class CapturedStep:
 
         cut = _cut_fn()
         blk, loss_fn = self._block, self._loss_fn
-        k = self._grad_accum
+        k = self._n_micro
+        pp_sched = self._pp_stages > 1
         want_guard, guard_on, clip = \
             self._want_guard, self._guard_on, self._clip
         has_scaler, has_label = self._has_scaler, self._has_label
@@ -529,7 +596,7 @@ class CapturedStep:
                 losses, grads, new_others = micro(
                     train_vals, other_vals, xs, ys, keys_b, keys_l,
                     scale)
-            else:
+            elif not pp_sched:
                 def body(carry, sl):
                     acc, others = carry
                     loss, gs, others = micro(
@@ -547,6 +614,44 @@ class CapturedStep:
                 acc0 = [jnp.zeros_like(v) for v in train_vals]
                 (grads, new_others), losses = jax.lax.scan(
                     body, (acc0, list(other_vals)), sl)
+            else:
+                # 1F1B-style shifted-carry schedule: the carry holds the
+                # PREVIOUS microbatch's gradients, and each tick drains
+                # them into the accumulator while the CURRENT
+                # microbatch's stages compute — the accumulate has no
+                # data dependence on this tick's micro(), so XLA is free
+                # to overlap its cross-stage (pp-axis) traffic with
+                # microbatch i+1's stage-s compute, exactly the
+                # comm/compute-overlap the schedule exists for.  The
+                # sharding constraint hands each gradient slice to its
+                # stage's devices (train_shs carries the pp placement of
+                # the *_stack_* params).  Bitwise: tick 0 adds an exact
+                # +0 array, after which the add chain sees operand-for-
+                # operand the same barriered sums as the flat scan — so
+                # captured(k, m) equals the eager oracle at
+                # grad_accum=k*m (pinned by tests/test_pipeline_*).
+                def body(carry, sl):
+                    acc, pending, others = carry
+                    acc = [cut(a + p) for a, p in zip(acc, pending)]
+                    loss, gs, others = micro(
+                        train_vals, others, sl["x"], sl.get("y"),
+                        sl["kb"], sl.get("kl"), scale)
+                    gs = [jax.lax.with_sharding_constraint(g, s)
+                          for g, s in zip(gs, train_shs)]
+                    return (acc, gs, others), loss
+
+                sl = {"x": xs, "kb": keys_b}
+                if has_label:
+                    sl["y"] = ys
+                if loss_keyed:
+                    sl["kl"] = keys_l
+                acc0 = [jnp.zeros_like(v) for v in train_vals]
+                pend0 = [jnp.zeros_like(v) for v in train_vals]
+                ((acc, pending, new_others), losses) = jax.lax.scan(
+                    body, (acc0, pend0, list(other_vals)), sl)
+                # cooldown drain: the last microbatch's grads are still
+                # in flight when the scan ends
+                grads = [cut(a + p) for a, p in zip(acc, pending)]
             health = cut(numerics.health_of(grads)) if want_guard \
                 else None
             new_train = list(train_vals)
@@ -638,7 +743,11 @@ class CapturedStep:
                                    for _i, _w, _g, st, _d in items])
                 dyn_list.append(_grouped.dyn_columns(
                     o, items, _np.dtype(gkey[2])))
-            k = self._grad_accum
+            # the in-program scan runs over n_micro slices (grad_accum ×
+            # pp_microbatches under the pipeline schedule): one RNG key
+            # per slice, batch reshaped to (n, b//n, ...) — matching the
+            # key-draw count of the eager oracle at grad_accum=n_micro
+            k = self._n_micro
             kbs, kls = [], []
             for _ in range(k):
                 kbs.append(_random.next_key())
@@ -793,6 +902,34 @@ class CapturedStep:
                 except Exception:
                     self._peak_bytes = None
         return self._peak_bytes
+
+    def pipeline_stats(self):
+        """Static 1F1B schedule accounting for this capture, or None on
+        a non-pipelined program: stage count, microbatch slices, the
+        warmup/cooldown slot counts, total schedule ticks, and the
+        derived ``bubble_fraction`` = (S−1)/(n+S−1)
+        (`parallel.pipeline.gpipe_bubble_fraction` — cross-checked by
+        tests against `_schedule_1f1b`'s measured idle fraction).  When
+        XLA cost analysis is available, ``flops_per_microbatch`` rides
+        along so trace_report can sanity-check the bubble against the
+        program's actual per-slice work."""
+        if self._pp_stages <= 1:
+            return None
+        from ..parallel.pipeline import gpipe_bubble_fraction
+
+        s, n = self._pp_stages, self._n_micro
+        out = {
+            "stages": s,
+            "microbatches": n,
+            "warmup": s - 1,
+            "cooldown": s - 1,
+            "ticks": n + s - 1,
+            "bubble_fraction": float(gpipe_bubble_fraction(s, n)),
+        }
+        flops = self.cost_flops()
+        if flops:
+            out["flops_per_microbatch"] = float(flops) / max(n, 1)
+        return out
 
     def collective_bytes_by_axis(self):
         """{axis: bytes-moved-per-device} over the step program's

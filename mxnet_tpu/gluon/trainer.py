@@ -225,6 +225,10 @@ class Trainer:
                     coll = step.collective_bytes_by_axis()
                     if coll:
                         telemetry.note(collective_bytes_by_axis=coll)
+                    pstats = step.pipeline_stats()
+                    if pstats is not None:
+                        telemetry.note(
+                            bubble_fraction=pstats["bubble_fraction"])
             else:
                 result = self._eager_train_step(
                     block, loss_fn, data, label, batch_size, k,

@@ -173,6 +173,7 @@ class HostCollector:
             "interval_us_mean": mean("interval_us"),
             "wall_us_mean": mean("wall_us"),
             "mfu_mean": mean("mfu"),
+            "bubble_fraction_mean": mean("bubble_fraction"),
             "shares": shares,
             "requests_total": self._requests,
             "request_queue_us_mean": round(

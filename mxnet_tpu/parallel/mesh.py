@@ -19,13 +19,18 @@ import numpy as _np
 DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
 
 
-def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
+def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None, axes=None):
     """Build a Mesh with the canonical axis order (pp, dp, sp, ep, tp).
 
     tp innermost: it carries the most latency-sensitive collectives, and the
     innermost mesh dim maps to physically-adjacent chips on the ICI torus
     (the scaling-book layout recipe).  pp outermost: stage transfers are
     point-to-point and tolerate DCN.
+
+    ``axes={"tp": 2, "pp": 2, "dp": 2}`` is the dict form for 3-axis
+    layouts — equivalent to the keyword form, same canonical order, same
+    per-axis validation; unknown axis names raise.  Mixing ``axes`` with
+    a non-default keyword size is ambiguous and raises.
     """
     import jax
     from jax.sharding import Mesh
@@ -33,6 +38,24 @@ def make_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
     override = devices is not None
     if devices is None:
         devices = jax.devices()
+    if axes is not None:
+        kw = {"pp": pp, "dp": dp, "sp": sp, "ep": ep, "tp": tp}
+        clash = [n for n, s in kw.items() if s != 1]
+        if clash:
+            raise ValueError(
+                "make_mesh: pass axis sizes either as keywords or via "
+                f"axes=, not both (keyword {clash[0]}={kw[clash[0]]!r} "
+                f"alongside axes={axes!r})")
+        unknown = [n for n in axes if n not in kw]
+        if unknown:
+            raise ValueError(
+                f"make_mesh: unknown axis {unknown[0]!r} in axes= "
+                f"(expected a subset of {sorted(kw)})")
+        pp = axes.get("pp", 1)
+        dp = axes.get("dp", 1)
+        sp = axes.get("sp", 1)
+        ep = axes.get("ep", 1)
+        tp = axes.get("tp", 1)
     sizes = {"pp": pp, "dp": dp, "sp": sp, "ep": ep, "tp": tp}
     for name, size in sizes.items():
         if not isinstance(size, int) or size < 1:

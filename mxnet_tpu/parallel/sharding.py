@@ -29,7 +29,7 @@ from __future__ import annotations
 import os
 import re
 
-from .mesh import DP, TP
+from .mesh import DP, PP, TP
 
 
 class ShardingRules:
@@ -41,7 +41,13 @@ class ShardingRules:
     always legal and resolves regex rules only.  When nothing matches,
     the ``default`` spec applies — ``()`` (fully replicated) unless the
     rule set was built with another default.
+
+    ``composable`` rule sets (class attribute, see `PPRules`) are
+    overlays: inside `combined_rules` their matches merge per-dim into
+    the winning base spec instead of competing whole-spec.
     """
+
+    composable = False
 
     def __init__(self, rules=(), default=()):
         self._rules = [(re.compile(p), spec) for p, spec in rules]
@@ -98,11 +104,19 @@ class FSDPRules(ShardingRules):
             else int(min_size)
 
     def _match(self, name, shape=None):
-        from jax.sharding import PartitionSpec
-
         spec = super()._match(name, shape)
         if spec is not None:
             return spec
+        return self._heuristic(shape)
+
+    def _heuristic(self, shape, avoid_dims=()):
+        """The shape heuristic alone (no regex): shard the FIRST
+        divisible dim not in ``avoid_dims`` — the avoidance hook lets
+        `combined_rules` re-run the heuristic around dims a composable
+        overlay (e.g. `PPRules`) already claimed, so pp+fsdp composes
+        instead of colliding on the stack dim."""
+        from jax.sharding import PartitionSpec
+
         if not shape:
             return None
         n = 1
@@ -111,12 +125,23 @@ class FSDPRules(ShardingRules):
         if n < self.min_size:
             return None
         for dim, d in enumerate(shape):
+            if dim in avoid_dims:
+                continue
             if self.axis_size is None or \
                     (self.axis_size > 0 and d % self.axis_size == 0):
                 entries = [None] * len(shape)
                 entries[dim] = self.axis
                 return PartitionSpec(*entries)
         return None
+
+    def _match_detail(self, name, shape=None):
+        """(spec, from_heuristic) — `combined_rules` uses the flag to
+        decide whether a same-dim overlay claim is a hard conflict (an
+        explicit regex said so) or a re-route (heuristic moves over)."""
+        spec = super()._match(name, shape)
+        if spec is not None:
+            return spec, False
+        return self._heuristic(shape), True
 
 
 def fsdp_rules(mesh=None, axis=DP, axis_size=None, min_size=None,
@@ -128,6 +153,51 @@ def fsdp_rules(mesh=None, axis=DP, axis_size=None, min_size=None,
         axis_size = mesh.shape.get(axis, 1)
     return FSDPRules(axis=axis, axis_size=axis_size, min_size=min_size,
                      rules=rules)
+
+
+class PPRules(ShardingRules):
+    """Pipeline-stage partitioning of the scanned trunk: a COMPOSABLE
+    overlay claiming the leading (layer-stack) dimension of every
+    ``*_stack_*`` parameter for the ``pp`` axis.
+
+    `combined_rules(PPRules(...), TRANSFORMER_TP_RULES)` merges the
+    claim per-dim into the base spec — ``qkv_stack_weight`` resolves to
+    ``('pp', 'tp', None)`` — rather than competing whole-spec; two sets
+    assigning DIFFERENT axes to the same dim of the same param is a
+    hard ValueError.  ``axis_size`` (bound via `pp_rules(mesh)`) guards
+    divisibility: a stack whose layer count the stage count does not
+    divide stays unclaimed rather than forcing GSPMD padding.
+    """
+
+    composable = True
+
+    def __init__(self, axis=PP, axis_size=None, pattern=r"_stack_",
+                 rules=None):
+        if rules is None:
+            rules = [(pattern, (axis,))]
+        super().__init__(rules=rules)
+        self.axis = axis
+        self.axis_size = axis_size
+
+    def _match(self, name, shape=None):
+        spec = super()._match(name, shape)
+        if spec is None:
+            return None
+        if self.axis_size and self.axis_size > 1 and shape:
+            for dim, e in enumerate(tuple(spec)):
+                if e is not None and (dim >= len(shape)
+                                      or shape[dim] % self.axis_size):
+                    return None
+        return spec
+
+
+def pp_rules(mesh=None, axis=PP, axis_size=None, pattern=r"_stack_"):
+    """`PPRules` bound to ``mesh``'s pp-axis size (stack-length
+    divisibility is checked against it); with no mesh, pass
+    ``axis_size`` directly or leave both None to claim unconditionally."""
+    if axis_size is None and mesh is not None:
+        axis_size = mesh.shape.get(axis, 1)
+    return PPRules(axis=axis, axis_size=axis_size, pattern=pattern)
 
 
 # default rule set for the transformer family (gluon/model_zoo/bert.py
@@ -179,18 +249,90 @@ MOE_EP_RULES = ShardingRules(rules=[
 
 
 class _CombinedRules(ShardingRules):
-    """First match wins ACROSS rule sets, shape heuristics included."""
+    """First match wins ACROSS rule sets, shape heuristics included.
+
+    Composable sets (`PPRules`) are the one exception: their matches
+    are per-dim CLAIMS merged into the winning base spec.  A claim on a
+    dim the base left None (or an absent trailing dim) fills it in; the
+    same axis on the same dim is idempotent; a DIFFERENT axis on a dim
+    an explicit base rule already assigned raises — silent override
+    here would reshard a param two sets disagree about.  When the base
+    came from the FSDP shape heuristic, the heuristic re-routes around
+    claimed dims instead (it never outranks an explicit claim)."""
 
     def __init__(self, sets):
         super().__init__()
         self._sets = list(sets)
 
     def _match(self, name, shape=None):
+        base = None            # (tuple spec, from_heuristic, rule set)
+        claims = []            # composable (tuple spec, rule set) in order
         for rs in self._sets:
-            spec = rs._match(name, shape)
-            if spec is not None:
-                return spec
-        return None
+            if getattr(rs, "composable", False):
+                spec = rs._match(name, shape)
+                if spec is not None:
+                    claims.append((tuple(spec), rs))
+            elif base is None:
+                if hasattr(rs, "_match_detail"):
+                    spec, heur = rs._match_detail(name, shape)
+                else:
+                    spec, heur = rs._match(name, shape), False
+                if spec is not None:
+                    base = (tuple(spec), heur, rs)
+        if not claims:
+            if base is None:
+                return None
+            from jax.sharding import PartitionSpec
+
+            return PartitionSpec(*base[0])
+        return self._merge(name, shape, base, claims)
+
+    @staticmethod
+    def _merge(name, shape, base, claims):
+        from jax.sharding import PartitionSpec
+
+        ndim = len(shape) if shape else max(
+            [len(s) for s, _ in claims]
+            + ([len(base[0])] if base else []))
+        merged = [None] * ndim
+        base_spec, base_heur, base_set = base if base else ((), False,
+                                                            None)
+        for dim, e in enumerate(base_spec[:ndim]):
+            merged[dim] = e
+        claimed_dims = set()
+        for spec, rs in claims:
+            for dim, e in enumerate(spec[:ndim]):
+                if e is None:
+                    continue
+                have = merged[dim]
+                if have is not None and have != e:
+                    if base_heur and dim not in claimed_dims:
+                        merged[dim] = None  # heuristic re-routes below
+                    else:
+                        raise ValueError(
+                            "combined_rules: conflicting axes for "
+                            f"{name!r} dim {dim}: {e!r} "
+                            f"(from {type(rs).__name__}) vs {have!r} — "
+                            "two rule sets may not assign different "
+                            "axes to the same dim of the same param")
+                if e in merged and merged.index(e) != dim:
+                    raise ValueError(
+                        "combined_rules: axis {!r} claimed twice for "
+                        "{!r} (dims {} and {}) — a mesh axis shards at "
+                        "most one dim per param".format(
+                            e, name, merged.index(e), dim))
+                merged[dim] = e
+                claimed_dims.add(dim)
+        if base_heur and base_set is not None:
+            # the heuristic's dim was taken: re-run it around the
+            # claimed dims and fold in what it finds
+            redo = base_set._heuristic(shape, avoid_dims=claimed_dims)
+            if redo is not None:
+                for dim, e in enumerate(tuple(redo)[:ndim]):
+                    if e is not None and merged[dim] is None \
+                            and e not in merged:
+                        merged[dim] = e
+        return PartitionSpec(*merged)
 
     def add(self, pattern, spec):
         # appended rules have the LOWEST precedence, matching the
@@ -200,12 +342,19 @@ class _CombinedRules(ShardingRules):
 
 
 def combined_rules(*rule_sets):
-    """Merge rule sets (first match wins across the concatenation) —
-    e.g. combined_rules(TRANSFORMER_TP_RULES, MOE_EP_RULES) for a
-    tp×ep transformer, or combined_rules(TRANSFORMER_TP_RULES,
-    fsdp_rules(mesh)) for TP weights with an FSDP fallback.  Every
-    rule (and shape heuristic) of an earlier set overrides every rule
-    of a later set on conflicting names."""
+    """Merge rule sets — e.g. combined_rules(TRANSFORMER_TP_RULES,
+    MOE_EP_RULES) for a tp×ep transformer, or
+    combined_rules(TRANSFORMER_TP_RULES, fsdp_rules(mesh)) for TP
+    weights with an FSDP fallback.
+
+    Precedence (pinned by tests/test_parallel.py): FIRST MATCH WINS
+    across the concatenation — every rule (and shape heuristic) of an
+    earlier set overrides every rule of a later set on conflicting
+    names, whole-spec, with no per-dim merging between ordinary sets.
+    `PPRules`-style ``composable`` overlays are the exception: their
+    per-dim claims merge into the winning base spec, and a conflicting
+    axis on the same dim of the same param is a hard ValueError (see
+    `_CombinedRules`)."""
     return _CombinedRules(rule_sets)
 
 
@@ -256,7 +405,7 @@ def shard_model(block, mesh, mode="tp", rules=None, axis=DP,
     the imperative twin of ShardedTrainer's staging, consumed by
     `gluon.Trainer.train_step`'s captured program (gluon/captured.py).
 
-    Two modes on one rule surface:
+    Modes on one rule surface:
 
     - ``mode='tp'``: Megatron tensor parallelism from ``rules``
       (default `TRANSFORMER_TP_RULES`) — Dense/attention weights split
@@ -267,6 +416,14 @@ def shard_model(block, mesh, mode="tp", rules=None, axis=DP,
       data axis (`fsdp_rules`); GSPMD gathers each layer's weights
       inside the step program and reduce-scatters its gradients.
       ``rules`` (if given) overrides the shape heuristic per name.
+    - ``mode='pp'``: pipeline stages only — `pp_rules(mesh)` claims the
+      leading layer-stack dim of every ``*_stack_*`` param for the
+      ``pp`` axis (scanned trunks: ScanTransformerEncoder / scan GPT).
+    - ``mode='tp_pp'``: the pp overlay merged over TP (``rules`` or
+      `TRANSFORMER_TP_RULES`) — qkv stacks land ('pp','tp',None); with
+      a dp axis on the same mesh this is the full tp×pp×dp layout.
+    - ``mode='pp_fsdp'``: the pp overlay over the FSDP shape heuristic;
+      the heuristic re-routes around the claimed stack dim.
 
     Initialized parameters (and their gradient buffers) are
     `jax.device_put` onto their `NamedSharding` immediately, making
@@ -292,9 +449,21 @@ def shard_model(block, mesh, mode="tp", rules=None, axis=DP,
         rules = base if rules is None else combined_rules(rules, base)
     elif mode == "tp":
         rules = TRANSFORMER_TP_RULES if rules is None else rules
+    elif mode == "pp":
+        overlay = pp_rules(mesh=mesh)
+        rules = overlay if rules is None \
+            else combined_rules(overlay, rules)
+    elif mode == "tp_pp":
+        base = TRANSFORMER_TP_RULES if rules is None else rules
+        rules = combined_rules(pp_rules(mesh=mesh), base)
+    elif mode == "pp_fsdp":
+        base = fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)
+        if rules is not None:
+            base = combined_rules(rules, base)
+        rules = combined_rules(pp_rules(mesh=mesh), base)
     else:
-        raise ValueError(f"shard_model: unknown mode {mode!r} "
-                         "(expected 'tp' or 'fsdp')")
+        raise ValueError(f"shard_model: unknown mode {mode!r} (expected "
+                         "'tp', 'fsdp', 'pp', 'tp_pp' or 'pp_fsdp')")
     from ..gluon.parameter import DeferredInitializationError
 
     specs = {}

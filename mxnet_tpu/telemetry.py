@@ -54,8 +54,12 @@ _LOCK = threading.Lock()
 # v4 (integrity plane): new ``integrity`` record type — one attestation
 # round per record: {step, fp, ok} plus optional {epoch, peers,
 # corrupt, kind}; v1/v2/v3 records stay valid.
-SCHEMA_VERSION = 4
-_ACCEPTED_VERSIONS = (1, 2, 3, 4)
+# v5 (pipeline parallelism): step records may carry ``bubble_fraction``
+# (the 1F1B schedule's idle share, in [0, 1)) next to mfu, and
+# ``collective_bytes_by_axis`` may grow a ``pp`` row; v1–v4 records
+# stay valid.
+SCHEMA_VERSION = 5
+_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -1188,4 +1192,9 @@ def validate_record(rec):
     if peak is not None and \
             (not isinstance(peak, (int, float)) or peak < 0):
         fail("device_peak_bytes must be a non-negative number or absent")
+    # optional pipeline field (schema v5): absent off the pp schedule
+    bf = rec.get("bubble_fraction")
+    if bf is not None and \
+            (not isinstance(bf, (int, float)) or not 0 <= bf < 1):
+        fail("bubble_fraction must be a number in [0, 1) or absent")
     return rec

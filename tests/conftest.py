@@ -90,6 +90,22 @@ def mesh8():
     parallel.set_default_mesh(None)
 
 
+@pytest.fixture
+def mesh222():
+    """The canonical 3-axis tp=2×pp=2×dp=2 mesh over the forced-host
+    8-device CPU platform — the PR 17 pipeline-parallel layout, built
+    through `make_mesh`'s dict form.  Same skip/teardown discipline as
+    `mesh8`."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+    yield parallel.make_mesh(axes={"tp": 2, "pp": 2, "dp": 2})
+    parallel.set_default_mesh(None)
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     """Reference: @with_seed() in tests/python/unittest/common.py —

@@ -421,6 +421,68 @@ def test_elastic_restore_dp4_onto_dp2_tp2_bitwise(tmp_path):
     dst.step(x, y)  # restored trainer still trains on the new mesh
 
 
+def test_elastic_restore_dp8_onto_tp2_pp2_dp2_bitwise(tmp_path):
+    """PR 17 acceptance: a checkpoint written under a pure ``dp=8``
+    mesh restores BITWISE onto the 3-axis ``tp=2×pp=2×dp=2`` layout —
+    the scanned trunk's layer-stack dim lands on the pp axis
+    (`pp_rules` composed over `TRANSFORMER_TP_RULES`), through the same
+    PR 5/9 elastic template path."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.bert import ScanTransformerEncoder
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = ScanTransformerEncoder(num_layers=2, units=16,
+                                     num_heads=2, hidden_size=32,
+                                     dropout=0.0)
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4, 16).astype(np.float32)
+    y = rng.randn(8, 4, 16).astype(np.float32)
+
+    # writer: pure data parallel over all 8 devices
+    src = parallel.ShardedTrainer(
+        build(3), gluon.loss.L2Loss(), "adam", {"learning_rate": 1e-2},
+        mesh=parallel.make_mesh(dp=8))
+    src.step(x, y)
+    src.step(x, y)
+    st = checkpoint.trainer_state(src)
+    frozen = [np.array(p, copy=True) for p in st["params"]]
+    _save_two_rank(tmp_path, 17, st)
+
+    # reader: the 3-axis pipeline layout — different init, must be
+    # overwritten bitwise by the restore
+    mesh = parallel.make_mesh(axes={"tp": 2, "pp": 2, "dp": 2})
+    rules = parallel.combined_rules(parallel.pp_rules(mesh),
+                                    parallel.TRANSFORMER_TP_RULES)
+    dst = parallel.ShardedTrainer(
+        build(99), gluon.loss.L2Loss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh, rules=rules)
+    dst.step(x, y)  # stage + one step of divergent training
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    restored = ck.restore(17, template=dst.state_template())
+    checkpoint.load_trainer_state(dst, restored)
+    specs = [tuple(sh.spec) for sh in dst._param_shardings]
+    assert any("pp" in s and "tp" in s for s in specs)  # 3-axis layout
+    for got, want, sh in zip(dst._param_vals, frozen,
+                             dst._param_shardings):
+        assert got.sharding.is_equivalent_to(sh, got.ndim)
+        assert np.array_equal(np.asarray(got), want)  # bitwise
+    assert dst._num_update == int(st["num_update"])
+    dst.step(x, y)  # restored trainer still trains on the new layout
+    parallel.set_default_mesh(None)
+
+
 def test_gluon_trainer_checkpoint_roundtrip_sharded(tmp_path):
     """The imperative gluon Trainer checkpoints through the SAME
     trainer_state/template/load surface (duck-typed): params + adam

@@ -64,6 +64,30 @@ def test_make_mesh_rejects_bad_axis_values():
             parallel.make_mesh(dp=bad)
 
 
+def test_make_mesh_axes_dict_form():
+    """PR 17 ergonomics: axes={...} builds the same mesh as keywords,
+    keeps the per-axis overflow ValueError naming the axis, and rejects
+    ambiguous keyword+dict mixes / unknown axis names."""
+    mesh = parallel.make_mesh(axes={"tp": 2, "pp": 2, "dp": 2})
+    assert mesh.shape == {"pp": 2, "dp": 2, "tp": 2}  # canonical order
+    kw = parallel.make_mesh(tp=2, pp=2, dp=2)
+    assert mesh.shape == kw.shape
+    assert [d.id for d in mesh.devices.flat] \
+        == [d.id for d in kw.devices.flat]
+    n = len(jax.devices())
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(axes={"pp": n + 1})
+    assert f"pp={n + 1}" in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(tp=2, axes={"dp": 2})
+    assert "not both" in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        parallel.make_mesh(axes={"zz": 2})
+    assert "unknown axis 'zz'" in str(ei.value)
+    with pytest.raises(ValueError):
+        parallel.make_mesh(axes={"dp": 0})
+
+
 # -- ShardingRules resolution order (pinned semantics) -------------------------
 
 def test_sharding_rules_first_match_wins():
@@ -126,6 +150,72 @@ def test_fsdp_rules_shape_heuristic():
     assert tuple(rules.spec_for("w", (6, 7))) == ()      # nothing divides
     assert tuple(rules.spec_for("w", None)) == ()        # unknown shape
     assert tuple(rules.spec_for("w", (4, 4, 4))) == ("dp", None, None)
+
+
+def test_combined_rules_three_way_tp_pp_dp_earlier_set_wins():
+    """Satellite (PR 17): earlier-set-wins holds for 3-way tp×pp×dp
+    composition with OVERLAPPING ``*_stack_*`` patterns — the ordinary
+    (non-composable) sets still compete whole-spec in order, while the
+    PPRules overlay merges per-dim on top of whichever won."""
+    tp = parallel.ShardingRules(rules=[
+        (r"qkv_stack_weight$", (None, "tp", None))])
+    # a later set with a BROADER overlapping stack pattern: must lose
+    dp = parallel.ShardingRules(rules=[
+        (r"_stack_weight$", (None, "dp", None)),
+        (r"_stack_bias$", (None, "dp"))])
+    combo = parallel.combined_rules(parallel.PPRules(), tp, dp)
+    # tp (earlier) wins the overlap whole-spec; pp merges onto dim 0
+    assert tuple(combo.spec_for("l_qkv_stack_weight", (4, 24, 8))) \
+        == ("pp", "tp", None)
+    # names only the later set matches fall through to it, pp on top
+    assert tuple(combo.spec_for("l_ffn9_stack_weight", (4, 64, 8))) \
+        == ("pp", "dp", None)
+    assert tuple(combo.spec_for("l_qkv_stack_bias", (4, 24))) \
+        == ("pp", "dp")
+    # swapping tp/dp order flips the overlap winner (earlier-set-wins)
+    combo2 = parallel.combined_rules(parallel.PPRules(), dp, tp)
+    assert tuple(combo2.spec_for("l_qkv_stack_weight", (4, 24, 8))) \
+        == ("pp", "dp", None)
+
+
+def test_combined_rules_conflicting_dim_assignment_raises():
+    """Two sets assigning DIFFERENT axes to the same dim of the same
+    param is a hard error naming the param, the dim and both axes —
+    not a silent override."""
+    dp0 = parallel.ShardingRules(rules=[
+        (r"_stack_weight$", ("dp", None, None))])
+    combo = parallel.combined_rules(parallel.PPRules(), dp0)
+    with pytest.raises(ValueError) as ei:
+        combo.spec_for("l_qkv_stack_weight", (4, 24, 8))
+    msg = str(ei.value)
+    assert "l_qkv_stack_weight" in msg and "dim 0" in msg
+    assert "'pp'" in msg and "'dp'" in msg
+    # same axis on the same dim is idempotent, not a conflict
+    pp0 = parallel.ShardingRules(rules=[
+        (r"_stack_weight$", ("pp", None, None))])
+    ok = parallel.combined_rules(parallel.PPRules(), pp0)
+    assert tuple(ok.spec_for("l_qkv_stack_weight", (4, 24, 8))) \
+        == ("pp", None, None)
+
+
+def test_pp_rules_divisibility_and_fsdp_reroute():
+    """A stack whose layer count the stage count does not divide stays
+    unclaimed; the FSDP shape heuristic re-routes around the claimed
+    stack dim instead of erroring (heuristic never outranks a claim)."""
+    rules = parallel.pp_rules(axis_size=2)
+    assert tuple(rules.spec_for("l_qkv_stack_weight", (4, 8, 8))) \
+        == ("pp",)
+    assert tuple(rules.spec_for("l_qkv_stack_weight", (3, 8, 8))) == ()
+    combo = parallel.combined_rules(
+        parallel.pp_rules(axis_size=2),
+        parallel.FSDPRules(axis_size=4, min_size=16))
+    # heuristic alone would take dim 0 (4 % 4 == 0); the pp claim moves
+    # it to the next divisible dim
+    assert tuple(combo.spec_for("l_ffn_stack_weight", (4, 8, 6))) \
+        == ("pp", "dp", None)
+    # non-stack params see the plain heuristic
+    assert tuple(combo.spec_for("l_dense_weight", (8, 4))) \
+        == ("dp", None)
 
 
 def test_match_partition_rules_bulk():

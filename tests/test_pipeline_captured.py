@@ -1,0 +1,318 @@
+"""Pipeline parallelism through the captured step (PR 17).
+
+The `pp` mesh axis partitions the scanned trunk's leading layer-stack
+dim into contiguous stages (parallel/sharding.py PPRules), and
+gluon/captured.py restructures the grad-accum scan into a 1F1B-style
+shifted-carry microbatch schedule — still ONE donated jit program, one
+dispatch + one readback per step.  Everything runs on the forced-host
+8-device CPU mesh (conftest).  Load-bearing claims:
+
+- a transformer trains on the 3-axis tp×pp×dp mesh with the PR 6
+  regression discipline intact (1 dispatch, 1 readback, 0 retraces,
+  cache hits post-warmup);
+- captured(grad_accum=k, pp_microbatches=m) is BITWISE equal to the
+  eager oracle at grad_accum=k*m, for k∈{1,2}×m∈{1,4};
+- MXTPU_PP=0 degenerates bitwise to the flat (PR 9) captured scan;
+- an indivisible k×m split raises up front, naming both knobs;
+- `bubble_fraction` lands in StepStats (telemetry schema v5) and
+  matches the analytic (S−1)/(n+S−1), cross-checked against the
+  measured 1F1B schedule table.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, numerics, parallel, telemetry
+from mxnet_tpu.gluon import captured, nn
+from mxnet_tpu.gluon.model_zoo.bert import ScanTransformerEncoder
+from mxnet_tpu.optimizer import grouped
+
+UNITS = 16
+
+
+def _scan_net(layers=2, units=UNITS, hidden=32, seed=7):
+    mx.random.seed(seed)
+    net = ScanTransformerEncoder(num_layers=layers, units=units,
+                                 num_heads=2, hidden_size=hidden,
+                                 dropout=0.0)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def _batch(rng, n=8, t=4):
+    x = mx.nd.array(rng.normal(size=(n, t, UNITS)).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, UNITS, size=(n, t))
+                    .astype(np.float32))
+    return x, y
+
+
+def _run(monkeypatch, mesh_axes, mode, captured_on=True, grad_accum=1,
+         pp="1", pp_m=None, steps=3, seed=3):
+    """One fresh train run; returns (losses, weights) as numpy."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1" if captured_on
+                       else "0")
+    monkeypatch.setenv("MXTPU_PP", pp)
+    if pp_m is None:
+        monkeypatch.delenv("MXTPU_PP_MICROBATCHES", raising=False)
+    else:
+        monkeypatch.setenv("MXTPU_PP_MICROBATCHES", str(pp_m))
+    mesh = parallel.make_mesh(axes=mesh_axes)
+    net = _scan_net()
+    parallel.shard_model(net, mesh, mode=mode)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    rng = np.random.RandomState(seed)
+    mx.random.seed(123)  # identical RNG-key stream across runs
+    losses = []
+    for _ in range(steps):
+        x, y = _batch(rng)
+        losses.append(np.asarray(
+            tr.train_step(net, loss_fn, x, y,
+                          grad_accum=grad_accum).asnumpy()).ravel())
+    weights = [p.data().asnumpy() for p in tr._params]
+    parallel.set_default_mesh(None)
+    return losses, weights, tr
+
+
+def _assert_bitwise(a, b):
+    for s, (x, y) in enumerate(zip(a[0], b[0])):
+        np.testing.assert_array_equal(x, y, err_msg=f"loss step {s}")
+    for i, (x, y) in enumerate(zip(a[1], b[1])):
+        np.testing.assert_array_equal(x, y, err_msg=f"weight {i}")
+
+
+# -- acceptance: 3-axis mesh, one donated program, zero retraces ---------------
+
+def test_tp_pp_dp_one_dispatch_one_readback_zero_retrace(
+        mesh222, monkeypatch):
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    monkeypatch.setenv("MXTPU_PP", "1")
+    net = _scan_net()
+    specs = parallel.shard_model(net, mesh222, mode="tp_pp")
+    assert any("pp" in tuple(s) and "tp" in tuple(s)
+               for s in specs.values())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    rng = np.random.RandomState(5)
+    for _ in range(2):  # warmup: trace + compile
+        x, y = _batch(rng)
+        tr.train_step(net, loss_fn, x, y)
+    captured.reset_counters()
+    grouped.reset_dispatch_count()
+    numerics.reset_readback_count()
+    for _ in range(4):
+        x, y = _batch(rng)
+        tr.train_step(net, loss_fn, x, y)
+    assert captured.dispatch_count() == 4
+    assert grouped.dispatch_count() == 0
+    assert numerics.readback_count() == 4
+    assert captured.trace_count() == 0
+    assert captured.cache_stats() == {"hits": 4, "misses": 0}
+    # the donated program IS pipelined: schedule accounting exists
+    step = next(iter(tr._captured_cache.values()))
+    stats = step.pipeline_stats()
+    assert stats["stages"] == 2
+    assert stats["microbatches"] == 2  # auto: pp size
+    assert 0.0 < stats["bubble_fraction"] < 1.0
+
+
+def test_pp_microbatches_knob_misses_capture_cache(mesh222, monkeypatch):
+    """pp_microbatches is a program-affecting knob: flipping it must
+    re-capture (new slice count = new program), not reuse."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    net = _scan_net()
+    parallel.shard_model(net, mesh222, mode="tp_pp")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    rng = np.random.RandomState(5)
+    monkeypatch.setenv("MXTPU_PP_MICROBATCHES", "2")
+    x, y = _batch(rng)
+    tr.train_step(net, loss_fn, x, y)
+    captured.reset_counters()
+    monkeypatch.setenv("MXTPU_PP_MICROBATCHES", "4")
+    x, y = _batch(rng)
+    tr.train_step(net, loss_fn, x, y)
+    assert captured.cache_stats()["misses"] == 1
+    parallel.set_default_mesh(None)
+
+
+# -- bitwise parity: grad-accum × microbatch grid (satellite) ------------------
+
+@pytest.mark.parametrize("k,m", [(1, 1), (1, 4), (2, 1), (2, 4)])
+def test_pp_schedule_bitwise_vs_eager_oracle(mesh8, monkeypatch, k, m):
+    """captured(grad_accum=k, pp_microbatches=m) == the eager oracle at
+    grad_accum=k*m, bitwise — the pipeline schedule re-orders WORK, not
+    arithmetic.  Pure-pp mesh: the (pre-existing, pp-independent)
+    captured-vs-eager divergence of dp-sharded microbatches at
+    grad_accum>1 is out of scope here."""
+    cap = _run(monkeypatch, {"pp": 2}, "pp", captured_on=True,
+               grad_accum=k, pp_m=m)
+    ora = _run(monkeypatch, {"pp": 2}, "pp", captured_on=False,
+               grad_accum=k * m)
+    _assert_bitwise(cap, ora)
+
+
+def test_pp_disabled_degenerates_to_flat_scan_bitwise(mesh8,
+                                                      monkeypatch):
+    """MXTPU_PP=0 on a pp mesh == the PR 9 flat grad-accum scan; and
+    the ACTIVE schedule at m=1 matches it bitwise too (the shifted
+    carry adds an exact +0, nothing else)."""
+    flat = _run(monkeypatch, {"pp": 2, "dp": 2}, "pp",
+                grad_accum=2, pp="0")
+    shifted = _run(monkeypatch, {"pp": 2, "dp": 2}, "pp",
+                   grad_accum=2, pp="1", pp_m=1)
+    _assert_bitwise(flat, shifted)
+
+
+# -- divisibility: hard error naming both knobs (satellite) --------------------
+
+def test_pp_indivisible_microbatch_split_raises(mesh8, monkeypatch):
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    monkeypatch.setenv("MXTPU_PP", "1")
+    monkeypatch.setenv("MXTPU_PP_MICROBATCHES", "4")
+    mesh = parallel.make_mesh(pp=2)
+    net = _scan_net()
+    parallel.shard_model(net, mesh, mode="pp")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    rng = np.random.RandomState(5)
+    x, y = _batch(rng, n=6)  # 6 % (2*4) != 0
+    with pytest.raises(ValueError) as ei:
+        tr.train_step(net, loss_fn, x, y, grad_accum=2)
+    msg = str(ei.value)
+    assert "grad_accum" in msg and "pp_microbatches" in msg
+    assert "6" in msg and "8" in msg
+    parallel.set_default_mesh(None)
+
+
+def test_resolve_pp_schedule_off_paths():
+    """No mesh / pp=1 / MXTPU_PP=0 all resolve to the flat scan."""
+    assert captured.resolve_pp_schedule(None, 2, 8) == (1, 1, 2)
+    mesh = parallel.make_mesh(dp=4)
+    assert captured.resolve_pp_schedule(mesh, 3, 9) == (1, 1, 3)
+    pmesh = parallel.make_mesh(pp=2)
+    import os
+    os.environ["MXTPU_PP"] = "0"
+    try:
+        assert captured.resolve_pp_schedule(pmesh, 2, 8) == (1, 1, 2)
+    finally:
+        del os.environ["MXTPU_PP"]
+    assert captured.resolve_pp_schedule(pmesh, 2, 8) == (2, 2, 4)
+
+
+# -- bubble_fraction: StepStats + schedule cross-check -------------------------
+
+def test_bubble_fraction_in_stepstats_and_crosscheck(mesh222,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    losses, _w, tr = _run(monkeypatch, {"tp": 2, "pp": 2, "dp": 2},
+                          "tp_pp")
+    assert all(np.isfinite(l).all() for l in losses)
+    recs = [r for r in telemetry.recent_steps()
+            if r.get("path") == "captured"
+            and r.get("bubble_fraction") is not None]
+    assert recs
+    rec = recs[-1]
+    telemetry.validate_record(rec)
+    assert 0.0 < rec["bubble_fraction"] < 1.0
+
+    from mxnet_tpu.parallel.pipeline import (_schedule_1f1b,
+                                             gpipe_bubble_fraction)
+
+    step = next(iter(tr._captured_cache.values()))
+    stats = step.pipeline_stats()
+    s, n = stats["stages"], stats["microbatches"]
+    assert rec["bubble_fraction"] == pytest.approx(
+        stats["bubble_fraction"])
+    # analytic warmup/cooldown accounting ...
+    assert stats["warmup"] == stats["cooldown"] == s - 1
+    assert stats["ticks"] == n + s - 1
+    assert stats["bubble_fraction"] == pytest.approx(
+        gpipe_bubble_fraction(s, n))
+    # ... cross-checked against the measured 1F1B schedule table
+    *_tables, bub = _schedule_1f1b(s, n)
+    assert abs(stats["bubble_fraction"] - bub) < 0.12
+
+
+def test_pp_collective_bytes_row(mesh222, monkeypatch):
+    """Per-axis collective accounting grows a ``pp`` row: the layer
+    scan over pp-sharded stacks moves bytes over the pp axis inside
+    the one captured program."""
+    monkeypatch.setenv("MXTPU_CAPTURED_STEP", "1")
+    _l, _w, tr = _run(monkeypatch, {"tp": 2, "pp": 2, "dp": 2},
+                      "tp_pp")
+    step = next(iter(tr._captured_cache.values()))
+    coll = step.collective_bytes_by_axis()
+    assert isinstance(coll, dict)
+    assert coll.get("pp", 0) > 0
+    assert coll.get("tp", 0) > 0
+
+
+def test_bubble_fraction_schema_validation():
+    """Schema v5: bubble_fraction must be a number in [0, 1) or
+    absent; v1–v4 records (no field) stay valid."""
+    base = None
+    for r in telemetry.recent_steps():
+        if r.get("type", "step") != "step":
+            continue
+        base = dict(r)
+        break
+    if base is None:
+        pytest.skip("no step record in the ring to mutate")
+    base.pop("bubble_fraction", None)
+    telemetry.validate_record(base)          # absent: valid (v1–v4)
+    base["bubble_fraction"] = 0.25
+    telemetry.validate_record(base)
+    for bad in (-0.1, 1.0, "big"):
+        base["bubble_fraction"] = bad
+        with pytest.raises(ValueError):
+            telemetry.validate_record(base)
+
+
+# -- trace_report pipeline section (CLI smoke) ---------------------------------
+
+def test_trace_report_pipeline_section(tmp_path, monkeypatch):
+    """A pipelined run's event log flows through the trace_report CLI:
+    the pipeline section aggregates bubble_fraction and the pp
+    hand-off bytes; --validate accepts the v5 records."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = os.path.join(repo, "tools", "trace_report.py")
+    path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", path)
+    telemetry.reset()
+    for step_id in range(2):
+        acc = telemetry.step_begin(path="captured")
+        telemetry.note(bubble_fraction=0.25,
+                       collective_bytes_by_axis={"pp": 4096,
+                                                 "tp": 1024,
+                                                 "all": 5120})
+        telemetry.step_end(acc, step=step_id)
+    telemetry.reset()                            # close the sink
+
+    env = dict(os.environ)
+    env.pop("MXTPU_TELEMETRY_PATH", None)
+    proc = subprocess.run(
+        [sys.executable, report, path, "--validate"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = proc.stdout
+    assert "records validate against schema" in out
+    assert "pipeline:" in out
+    assert "bubble_fraction: mean 0.2500" in out
+    assert "min 0.2500" in out and "max 0.2500" in out
+    assert "pp hand-off: mean 4096 bytes/step/device" in out

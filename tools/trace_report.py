@@ -134,6 +134,7 @@ def report_run(run, records, out):
             ids = [s.get("step") for s in skipped]
             out.write(f"  skipped steps: {len(skipped)} "
                       f"(ids {ids})\n")
+        report_pipeline(steps, out)
     if events:
         kinds = {}
         for e in events:
@@ -153,6 +154,29 @@ def report_run(run, records, out):
             report_integrity({}, attestations, out)
         if trials:
             report_autotune({}, trials, out)
+
+
+def report_pipeline(steps, out):
+    """Pipeline-schedule section (docs/parallel.md "Pipeline
+    parallelism on the captured step"): the bubble share the 1F1B
+    microbatch schedule paid, aggregated over the run's steps, plus
+    the per-device bytes the stage grad hand-off moved on the ``pp``
+    mesh axis.  Prints nothing for unpipelined runs — no step record
+    carries ``bubble_fraction`` (schema v5)."""
+    bubbles = [s.get("bubble_fraction") for s in steps
+               if s.get("bubble_fraction") is not None]
+    if not bubbles:
+        return
+    out.write("  pipeline:\n")
+    out.write(f"    bubble_fraction: mean {_mean(bubbles):.4f}  "
+              f"min {min(bubbles):.4f}  max {max(bubbles):.4f} "
+              f"over {len(bubbles)} step(s)\n")
+    pp_bytes = [s["collective_bytes_by_axis"]["pp"] for s in steps
+                if isinstance(s.get("collective_bytes_by_axis"), dict)
+                and s["collective_bytes_by_axis"].get("pp")]
+    if pp_bytes:
+        out.write(f"    pp hand-off: mean {_mean(pp_bytes):.0f} "
+                  f"bytes/step/device\n")
 
 
 def report_integrity(kinds, attestations, out):
