@@ -213,6 +213,23 @@ def _trainer_attempts(tpu_ok):
     return attempts
 
 
+def _embedding_attempts(tpu_ok):
+    cfg = {"model": "embedding",
+           "vocab": int(os.environ.get("BENCH_EMB_VOCAB", 4096)),
+           "dim": int(os.environ.get("BENCH_EMB_DIM", 64)),
+           "batch": int(os.environ.get("BENCH_EMB_BATCH", 512)),
+           "steps": int(os.environ.get("BENCH_EMB_STEPS", 20))}
+    attempts = []
+    if tpu_ok:
+        attempts.append((None, dict(cfg, backend="tpu"), 240))
+    # the captured-vs-eager ratio gate is meaningful on any backend;
+    # CPU numbers survive only under embedding_on_chip_unavailable
+    # tagging
+    attempts.append(({"JAX_PLATFORMS": "cpu"},
+                     dict(cfg, backend="cpu"), 240))
+    return attempts
+
+
 def _sharded_attempts(tpu_ok):
     steps = int(os.environ.get("BENCH_SHARDED_STEPS", 10))
     cfg = {"model": "sharded_step", "batch": 8, "steps": steps}
@@ -1012,6 +1029,14 @@ def orchestrate():
                 trainer_restart = _run_worker(env_over, cfg, budget,
                                               trainer_errors)
                 break
+    emb = None
+    emb_errors = []
+    if headline is not None \
+            and not os.environ.get("BENCH_SKIP_EMBEDDING"):
+        for env_over, cfg, budget in _embedding_attempts(tpu_ok):
+            emb = _run_worker(env_over, cfg, budget, emb_errors)
+            if emb is not None:
+                break
     pipe = None
     pipe_errors = []
     if headline is not None and not os.environ.get("BENCH_SKIP_PIPELINE"):
@@ -1168,6 +1193,40 @@ def orchestrate():
         headline["trainer_gates_ok"] = all(gates.values())
     elif trainer_errors:
         headline["trainer_error"] = "; ".join(trainer_errors)[-300:]
+    if emb is not None:
+        headline["embedding_ids_per_sec"] = emb["value"]
+        headline["embedding_captured_step_us"] = emb.get("captured_us")
+        headline["embedding_eager_step_us"] = emb.get("eager_us")
+        headline["embedding_speedup_vs_eager"] = \
+            emb.get("speedup_vs_eager")
+        headline["embedding_lookup_stall_share"] = \
+            emb.get("lookup_stall_share")
+        headline["embedding_unique_fraction"] = \
+            emb.get("unique_fraction")
+        # ratio gates (trainer_gates discipline): the captured sparse
+        # step must not lose to its own eager oracle, and must keep the
+        # one-dispatch-per-step contract
+        emb_gates = {
+            "sparse_captured_le_eager":
+                bool(emb.get("sparse_captured_le_eager")),
+            "one_dispatch_per_step":
+                emb.get("dispatches") == emb.get("steps_timed")
+                and bool(emb.get("steps_timed")),
+        }
+        headline["embedding_gates"] = emb_gates
+        headline["embedding_gates_ok"] = all(emb_gates.values())
+        # forced-host numbers survive only tagged, never as an on-chip
+        # result (sharded_on_chip_unavailable discipline)
+        if emb.get("backend") == "cpu":
+            headline["embedding_on_chip_unavailable"] = {
+                "reason": probe_note if not tpu_ok
+                else "tpu attempts failed; cpu fallback produced the "
+                     "embedding numbers",
+                "fallback_backend": "cpu",
+                "numbers_are_cpu": True,
+            }
+    elif emb_errors:
+        headline["embedding_error"] = "; ".join(emb_errors)[-300:]
     if pipe is not None:
         headline["input_pipeline_imgs_per_sec"] = pipe["value"]
         headline["input_pipeline_imgs_per_sec_legacy"] = \
@@ -1656,6 +1715,8 @@ def worker(cfg):
         bench_input_pipeline(cfg, devices)
     elif cfg["model"] == "ckpt":
         bench_ckpt(cfg, devices)
+    elif cfg["model"] == "embedding":
+        bench_embedding(cfg, devices)
     elif cfg["model"] == "sharded_step":
         bench_sharded(cfg, devices)
     elif cfg["model"] == "pp_step":
@@ -2102,6 +2163,117 @@ def bench_trainer(cfg, devices):
         and guard_overhead_pct < 5.0,
         "params": actual,
         "batch": n_params,
+        "backend": devices[0].platform,
+    }))
+
+
+def bench_embedding(cfg, devices):
+    """embeddings_per_sec: the recommender workload — a row-sparse
+    `ShardedEmbedding` table + dense head trained end to end, two ways
+    on the same model:
+
+    - captured (the reported value): host unique/inverse id prep, the
+      in-program padded gather, segment-sum scatter-add row update —
+      one dispatch + one readback per step (gluon/captured.py +
+      embedding/prep.py);
+    - eager (MXTPU_SPARSE_CAPTURED=0): the RowSparseNDArray op-by-op
+      oracle the captured program is bitwise-checked against
+      (tests/test_embedding.py).
+
+    Ids are zipf-skewed (hot head + long tail, like real id traffic).
+    Also reported: lookup-stall share (host id-prep time / step time,
+    from the schema-v6 ``lookup_us`` StepStats field), the mean
+    ``unique_fraction``, and the ``sparse_captured_le_eager`` ratio
+    gate — a ratio on the same box, so meaningful on any backend."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import embedding, gluon, telemetry
+    from mxnet_tpu.gluon import captured, nn
+
+    vocab, dim = cfg["vocab"], cfg["dim"]
+    batch, steps = cfg["batch"], cfg["steps"]
+
+    net = nn.HybridSequential(prefix="benchemb_")
+    with net.name_scope():
+        net.add(embedding.ShardedEmbedding(vocab, dim),
+                nn.Dense(1, in_units=dim, flatten=False))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+
+    def loss_fn(out):
+        return (out ** 2).sum()
+
+    rng = np.random.RandomState(0)
+    ids = rng.zipf(1.3, size=(steps + 8, batch)) % vocab
+    xs = [mx.nd.array(b.astype("float32")) for b in ids]
+    cursor = [0]
+
+    def step():
+        x = xs[cursor[0] % len(xs)]
+        cursor[0] += 1
+        return trainer.train_step(net, loss_fn, x, batch_size=batch)
+
+    # warmup: trace + compile every unique-count bucket the id stream
+    # hits (pow-2 buckets, so a handful at most)
+    for _ in range(4):
+        _readback(step())
+    captured.reset_counters()
+    telemetry.reset()
+    dt, _ = _timed_loop(step, steps, per_step_readback=True)
+    captured_us = dt / steps * 1e6
+    embeddings_per_sec = batch * steps / dt
+    stats = captured.cache_stats()
+    traces = captured.trace_count()
+    dispatches = captured.dispatch_count()
+
+    recs = [r for r in telemetry.recent_steps()
+            if r.get("path") == "captured"][-steps:]
+
+    def _mean(key):
+        vals = [r.get(key) for r in recs]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) / len(vals) if vals else None
+
+    lookup_us = _mean("lookup_us")
+    unique_fraction = _mean("unique_fraction")
+    lookup_stall_share = lookup_us / captured_us \
+        if lookup_us is not None and captured_us else None
+
+    # eager row-sparse oracle, same process (the flag is read per step)
+    os.environ["MXTPU_SPARSE_CAPTURED"] = "0"
+    try:
+        _readback(step())
+        _readback(step())
+        dt2, _ = _timed_loop(step, steps, per_step_readback=True)
+        eager_us = dt2 / steps * 1e6
+    finally:
+        os.environ.pop("MXTPU_SPARSE_CAPTURED", None)
+
+    print(json.dumps({
+        "metric": "embeddings_per_sec",
+        "value": round(embeddings_per_sec, 1),
+        "unit": "ids/sec",
+        "vs_baseline": None,
+        "captured_us": round(captured_us, 1),
+        "eager_us": round(eager_us, 1),
+        "speedup_vs_eager": round(eager_us / captured_us, 2)
+        if captured_us else None,
+        "sparse_captured_le_eager": captured_us <= eager_us,
+        "lookup_us": round(lookup_us, 1)
+        if lookup_us is not None else None,
+        "lookup_stall_share": round(lookup_stall_share, 4)
+        if lookup_stall_share is not None else None,
+        "unique_fraction": round(unique_fraction, 4)
+        if unique_fraction is not None else None,
+        "cache_hits": stats["hits"],
+        "cache_misses": stats["misses"],
+        "traces": traces,
+        "dispatches": dispatches,
+        "steps_timed": len(recs),
+        "vocab": vocab, "dim": dim, "batch": batch,
         "backend": devices[0].platform,
     }))
 

@@ -54,7 +54,7 @@ for _mod in ("initializer", "optimizer", "metric", "callback", "kvstore",
              "symbol", "model", "module", "lr_scheduler", "distributed",
              "amp", "checkpoint", "contrib", "rtc", "image_detection",
              "subgraph", "attribute", "monitor", "resilience", "numerics",
-             "telemetry", "serving", "autotune"):
+             "telemetry", "serving", "autotune", "embedding"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

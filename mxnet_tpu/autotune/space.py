@@ -122,6 +122,17 @@ register(Knob(
         "(captured(k, m) matches the eager oracle at grad_accum=k*m), "
         "so the search touches it only with MXTPU_TUNE_SEMANTICS=1"))
 register(Knob(
+    "unique_bucket", "MXTPU_UNIQUE_BUCKET",
+    ("0", "256", "1024", "4096"), "0", layer="program",
+    doc="fixed unique-id bucket for captured sparse-embedding steps, "
+        "0 = auto (next power of two per batch); program-affecting — "
+        "the bucket is the padded gather width and joins the capture "
+        "key (embedding/prep.py, gluon/captured.py).  A fixed bucket "
+        "trades one capture signature for padding waste; a batch whose "
+        "unique count exceeds it falls back to the eager oracle with a "
+        "sparse_fallback telemetry event.  Bitwise-neutral: padded "
+        "rows never reach the table"))
+register(Knob(
     "grad_accum", "MXTPU_GRAD_ACCUM",
     ("1", "2", "4"), "1", layer="schedule",
     numerics_preserving=False,

@@ -1,0 +1,46 @@
+"""Sharded embedding tables on the captured-step path (the recommender
+workload).
+
+Embedding(sparse_grad=True) has been an eager-only configuration since
+the sparse milestone: the compact row-sparse gradient lives on the eager
+tape, so whole-step capture (gluon/captured.py) declined it and the one
+workload that most stresses "millions of users" ran multi-dispatch.
+This package promotes the sparse path INTO the donated program:
+
+- `ShardedEmbedding` — a Gluon block whose table parameter is named
+  ``embed_table`` so the `EmbeddingRules` overlay
+  (parallel/sharding.py) row-shards it over the dp/fsdp mesh axis,
+  composable with TP/PP via the per-dim merge.  Inside a captured trace
+  the lookup becomes gather(gathered-unique-rows, inverse-index); on
+  the eager tape it stays the compact `sparse_embedding` op — the
+  bitwise parity oracle.
+- host-side id prep (`prep.prepare_step`): unique ids + inverse index
+  computed on the host (or ahead of time on the DevicePrefetcher's
+  producer thread), padded to a power-of-two unique-count bucket that
+  joins the capture key, so retraces are bounded by the number of
+  distinct buckets and the step keeps exactly one dispatch + one
+  readback.
+- the row-sparse update itself runs through
+  `optimizer.grouped.sparse_row_kernel` — the same fused SGD/Adam
+  kernels on just the gathered rows, shared by the eager grouped path
+  and the captured program (PR 6 bitwise-oracle discipline).
+
+``MXTPU_SPARSE_CAPTURED=0`` pins sparse configs to the eager oracle;
+any forced fallback (dist kvstore, indivisible bucket, foreign
+optimizer) emits a ``sparse_fallback{reason}`` telemetry event rather
+than degrading silently.
+"""
+
+from .prep import (SparsePrep, bucket_for, capture_scope,
+                   find_sparse_embeddings, pop_prep, prepare_step,
+                   rows_lookup, scope_entry, sparse_capture_reason,
+                   sparse_captured_enabled, stash_prep,
+                   unique_bucket_env)
+from .sharded import ShardedEmbedding
+
+__all__ = [
+    "ShardedEmbedding", "SparsePrep", "bucket_for", "capture_scope",
+    "find_sparse_embeddings", "pop_prep", "prepare_step", "rows_lookup",
+    "scope_entry", "sparse_capture_reason", "sparse_captured_enabled",
+    "stash_prep", "unique_bucket_env",
+]

@@ -1,0 +1,291 @@
+"""Host-side id preparation for captured sparse-embedding steps.
+
+The captured program cannot compute ``np.unique`` — shapes must be
+static under jit — so the host computes, per step and per table:
+
+    ids  = clip(astype(int32, feature-slice(batch)), 0, vocab-1).ravel()
+    uniq, inv = np.unique(ids, return_inverse=True)
+
+(exactly the clip/cast order of the eager `ops.indexing
+.sparse_embedding` op, so the two paths agree on which row every id
+reads), then pads ``uniq`` to a power-of-two bucket with the sentinel
+id ``vocab``.  The sentinel is OUT of range on purpose: the in-program
+pre-gather reads it with ``mode='clip'`` (deterministic, no NaN), and
+every scatter back to the table drops out-of-bounds rows, so padded
+slots write nothing.  The bucket size joins the capture key — retraces
+are bounded by the number of distinct buckets, not by per-batch unique
+counts.
+
+The DevicePrefetcher's producer thread calls `prepare_step` one batch
+ahead and stashes the result (`stash_prep`/`pop_prep`), overlapping the
+unique/inverse work — the dominant host_prep cost of a sparse step —
+with the current step's device compute.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+import numpy as _np
+
+
+def sparse_captured_enabled() -> bool:
+    """MXTPU_SPARSE_CAPTURED gate (default on); 0/false/off pins
+    sparse_grad=True configurations to the eager row-sparse oracle."""
+    return os.environ.get("MXTPU_SPARSE_CAPTURED", "1").lower() \
+        not in ("0", "false", "off", "")
+
+
+def unique_bucket_env() -> int:
+    """MXTPU_UNIQUE_BUCKET: fixed unique-count bucket (one capture
+    signature for every batch whose unique count fits), or 0 (default)
+    for automatic next-power-of-two bucketing.  An autotune knob
+    (autotune/space.py, layer='program'): a changed value re-captures
+    via `program_knob_values` in the capture key."""
+    try:
+        return max(0, int(os.environ.get("MXTPU_UNIQUE_BUCKET", "0")))
+    except ValueError:
+        return 0
+
+
+def bucket_for(n_real: int):
+    """Padded unique-count bucket for ``n_real`` unique ids: the fixed
+    MXTPU_UNIQUE_BUCKET when set (None when the batch does not fit —
+    the caller falls back to the eager oracle, with a telemetry
+    reason), else the next power of two."""
+    fixed = unique_bucket_env()
+    if fixed:
+        return fixed if n_real <= fixed else None
+    b = 1
+    while b < max(int(n_real), 1):
+        b *= 2
+    return b
+
+
+class SparsePrep:
+    """One table's host-prepared lookup indices for one batch."""
+
+    __slots__ = ("uniq", "inv", "bucket", "n_real", "n_ids", "vocab")
+
+    def __init__(self, uniq, inv, bucket, n_real, n_ids, vocab):
+        self.uniq = uniq          # np.int32 (bucket,) padded with vocab
+        self.inv = inv            # np.int32 (n_ids,) into uniq
+        self.bucket = int(bucket)
+        self.n_real = int(n_real)
+        self.n_ids = int(n_ids)
+        self.vocab = int(vocab)
+
+
+def _n_ids_of(shape, feature):
+    """Flat id count a (batch) shape yields under a feature selector."""
+    n = 1
+    if feature is None:
+        for d in shape:
+            n *= int(d)
+        return n
+    for d in shape[:-1]:
+        n *= int(d)
+    if isinstance(feature, slice):
+        start, stop, step = feature.indices(int(shape[-1]))
+        return n * len(range(start, stop, step))
+    return n
+
+
+def extract_ids(data, feature, vocab):
+    """Flat clipped int32 ids from a batch — the host twin of the eager
+    op's ``clip(astype(int32, x), 0, vocab-1)`` (cast-then-clip order
+    matters: both truncate floats toward zero first)."""
+    arr = _np.asarray(getattr(data, "_data", data))
+    if feature is not None:
+        arr = arr[..., feature]
+    ids = arr.astype(_np.int32)
+    return _np.clip(ids, 0, vocab - 1).ravel()
+
+
+def prepare_one(data, block):
+    """`SparsePrep` for one ShardedEmbedding on one batch, or None when
+    the unique count exceeds a fixed MXTPU_UNIQUE_BUCKET."""
+    vocab = block._input_dim
+    ids = extract_ids(data, block._feature, vocab)
+    uniq, inv = _np.unique(ids, return_inverse=True)
+    bucket = bucket_for(uniq.shape[0])
+    if bucket is None:
+        return None
+    padded = _np.full((bucket,), vocab, _np.int32)
+    padded[:uniq.shape[0]] = uniq
+    return SparsePrep(uniq=padded, inv=inv.astype(_np.int32).ravel(),
+                      bucket=bucket, n_real=uniq.shape[0],
+                      n_ids=ids.size, vocab=vocab)
+
+
+def find_sparse_embeddings(block):
+    """{id(table param): ShardedEmbedding} over a block tree."""
+    from .sharded import ShardedEmbedding
+
+    found = {}
+
+    def walk(b):
+        if isinstance(b, ShardedEmbedding) and b._sparse_grad:
+            found[id(b.weight)] = b
+        for child in getattr(b, "_children", {}).values():
+            walk(child)
+
+    walk(block)
+    return found
+
+
+def sparse_capture_reason(trainer, block, sparse_params):
+    """Why row-sparse params cannot enter the captured program, or None.
+    ``sparse_params``: [(trainer index, Parameter)] with row_sparse
+    grad_stype.  The returned string doubles as the ``sparse_fallback``
+    telemetry reason."""
+    from ..optimizer import optimizer as _optmod
+
+    if not sparse_captured_enabled():
+        return "sparse capture disabled (MXTPU_SPARSE_CAPTURED=0)"
+    o = trainer._optimizer
+    if type(o) not in (_optmod.SGD, _optmod.Adam):
+        return f"optimizer {type(o).__name__} has no row-sparse " \
+               "fused plan"
+    if not getattr(o, "lazy_update", True):
+        return "lazy_update=False densifies row-sparse gradients"
+    emb = find_sparse_embeddings(block)
+    for _i, p in sparse_params:
+        if id(p) not in emb:
+            return "sparse_grad=True parameter outside ShardedEmbedding"
+    return None
+
+
+def _prep_valid(pr, data, block):
+    """A stashed prep is only usable if it still describes THIS batch
+    shape, table, and bucket policy (the env knob may have moved)."""
+    return (isinstance(pr, SparsePrep)
+            and pr.vocab == block._input_dim
+            and pr.n_ids == _n_ids_of(data.shape, block._feature)
+            and bucket_for(pr.n_real) == pr.bucket)
+
+
+def prepare_step(block, data, sparse_params):
+    """Per-step host prep for every sparse table, prefetcher-stash
+    aware.  Returns ``(preps, reason, lookup_us)``: ``preps`` is a list
+    of `SparsePrep` aligned with ``sparse_params`` (None with a
+    ``reason`` string on fallback); ``lookup_us`` is the host time
+    spent here — near zero when the producer thread prepared ahead."""
+    t0 = time.perf_counter()
+    cached = pop_prep(data) or {}
+    emb = find_sparse_embeddings(block)
+    preps = []
+    for _i, p in sparse_params:
+        b = emb.get(id(p))
+        if b is None:
+            return (None,
+                    "sparse_grad=True parameter outside ShardedEmbedding",
+                    (time.perf_counter() - t0) * 1e6)
+        pr = cached.get(id(p))
+        if pr is not None and not _prep_valid(pr, data, b):
+            pr = None
+        if pr is None:
+            pr = prepare_one(data, b)
+        if pr is None:
+            return (None,
+                    "unique count exceeds MXTPU_UNIQUE_BUCKET="
+                    f"{unique_bucket_env()}",
+                    (time.perf_counter() - t0) * 1e6)
+        preps.append(pr)
+    return preps, None, (time.perf_counter() - t0) * 1e6
+
+
+# -- prefetcher handoff (gluon/data/prefetcher.py producer thread) -------------
+#
+# Keyed by the YIELDED batch object's identity, holding a strong ref so
+# the id cannot be recycled while the entry lives; one-shot pop on the
+# consumer side, FIFO-bounded so an abandoned iterator cannot leak.
+
+_PREP_CACHE = {}
+_PREP_CACHE_MAX = 8
+
+
+def stash_prep(data_nd, preps):
+    """Producer-side: remember ``{id(table param): SparsePrep}`` for a
+    batch about to be yielded."""
+    while len(_PREP_CACHE) >= _PREP_CACHE_MAX:
+        _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
+    _PREP_CACHE[id(data_nd)] = (data_nd, dict(preps))
+
+
+def pop_prep(data_nd):
+    """Consumer-side: the stashed preps for exactly this batch object,
+    or None.  One-shot."""
+    entry = _PREP_CACHE.pop(id(data_nd), None)
+    if entry is None or entry[0] is not data_nd:
+        return None
+    return entry[1]
+
+
+# -- capture-trace plumbing ----------------------------------------------------
+#
+# While gluon/captured.py traces a sparse step it maps each table
+# param's id to the microbatch's inverse-index tracer; ShardedEmbedding
+# .hybrid_forward switches on the entry's presence, so the SAME block
+# hybridizes into a plain CachedOp (dense gather) when no captured
+# sparse trace is active.
+
+_SCOPE = {}
+
+
+@contextmanager
+def capture_scope(mapping):
+    saved = dict(_SCOPE)
+    _SCOPE.update(mapping)
+    try:
+        yield
+    finally:
+        _SCOPE.clear()
+        _SCOPE.update(saved)
+
+
+def scope_entry(param_id):
+    return _SCOPE.get(param_id)
+
+
+def rows_lookup(rows, inv, out_shape):
+    """In-program lookup over pre-gathered unique rows, with the eager
+    sparse op's EXACT backward math.
+
+    Forward: ``take(rows, inv)`` — composed with the pre-gather
+    ``take(table, uniq)`` this reads bit-identical elements to the
+    eager ``take(table, clipped_ids)`` (pure data movement).  Backward
+    (custom_vjp, `jax.ops.segment_sum`): cotangents coalesce per unique
+    row in float32 and cast back to the table dtype — operand-for-
+    operand the eager op's backward, with `_cut` barriers where the
+    eager tape materializes arrays (the incoming cotangent, the
+    coalesced values, the lookup output), so XLA's fusion/contraction
+    decisions partition exactly like the eager dispatch chain.  Padded
+    bucket slots are segments no ``inv`` entry targets: their gradient
+    rows are exact zeros."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..gluon.captured import _cut_fn
+
+    cut = _cut_fn()
+    n_rows = rows.shape[0]
+    dtype = rows.dtype
+
+    @jax.custom_vjp
+    def lookup(r):
+        return jnp.take(r, inv, axis=0)
+
+    def _fwd(r):
+        return jnp.take(r, inv, axis=0), None
+
+    def _bwd(_res, ct):
+        ct = cut(ct)
+        vals = jax.ops.segment_sum(ct.astype(jnp.float32), inv,
+                                   num_segments=n_rows)
+        return (cut(vals.astype(dtype)),)
+
+    lookup.defvjp(_fwd, _bwd)
+    return cut(lookup(rows)).reshape(out_shape)

@@ -1,0 +1,115 @@
+"""ShardedEmbedding — the row-sharded, capture-eligible embedding table."""
+
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from . import prep as _prep
+
+
+class ShardedEmbedding(HybridBlock):
+    """Index → vector lookup whose table row-shards over the mesh and
+    whose sparse gradient runs INSIDE the captured train step.
+
+    The table parameter is named ``embed_table`` — not ``*_weight`` —
+    so the `parallel.sharding.EmbeddingRules` overlay claims its row
+    (vocab) dim for the dp/fsdp axis without colliding with
+    TRANSFORMER_TP_RULES' column-parallel ``embedding\\d*_weight`` rule;
+    an explicit user rule on the output dim merges per-dim (PR 17).
+
+    Three forward modes, switched per trace:
+
+    - captured (gluon/captured.py active, `prep.capture_scope` holds
+      this table's inverse-index tracer): ``embed_table`` arrives as
+      the program's pre-gathered ``(bucket, dim)`` unique rows and the
+      lookup is `prep.rows_lookup` — gather by inverse index forward,
+      `segment_sum` coalesce backward, bitwise-equal to the eager op;
+    - eager tape: the compact `ops.indexing.sparse_embedding` op
+      (O(touched rows) gradient) — the parity oracle;
+    - plain jit / symbol / sparse_grad=False: the dense ``F.Embedding``
+      gather, whose scatter-add transpose is already the fused row
+      update under jit.
+
+    ``feature`` selects the id column(s) from the LAST axis of the
+    input (an int or a slice), for recommender batches that carry the
+    categorical fields inside one dense feature tensor; None means the
+    input IS the id tensor.  Under capture, the host id-prep applies
+    the same selector to the same batch — the block must consume the
+    step's ``data`` (or its feature slice) directly.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=True, feature=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = int(input_dim)
+        self._output_dim = int(output_dim)
+        self._sparse_grad = bool(sparse_grad)
+        if feature is not None and not isinstance(feature, (int, slice)):
+            raise TypeError(
+                "ShardedEmbedding: feature must be None, an int, or a "
+                f"slice of the last input axis, got {type(feature)}")
+        self._feature = feature
+        self._kwargs = {"input_dim": int(input_dim),
+                        "output_dim": int(output_dim)}
+        with self.name_scope():
+            # registered under the attribute name ``embed_table`` so the
+            # hybrid_forward kwarg and the parameter name agree
+            self.embed_table = self.params.get(
+                "embed_table", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype,
+                allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
+
+    @property
+    def weight(self):
+        """Alias matching ``gluon.nn.Embedding.weight``."""
+        return self.embed_table
+
+    def _ids_shape(self, x):
+        shp = tuple(x.shape)
+        if self._feature is None:
+            return shp
+        if isinstance(self._feature, slice):
+            start, stop, step = self._feature.indices(int(shp[-1]))
+            return shp[:-1] + (len(range(start, stop, step)),)
+        return shp[:-1]
+
+    def hybrid_forward(self, F, x, embed_table):
+        from ..autograd import is_recording
+        from ..ndarray.ndarray import NDArray, _from_jax
+
+        inv = _prep.scope_entry(id(self.weight))
+        if inv is not None:
+            # captured trace: embed_table is the pre-gathered unique
+            # rows; ids already folded into inv on the host
+            out_shape = self._ids_shape(x) + (self._output_dim,)
+            return _prep.rows_lookup(embed_table, inv, out_shape)
+        from ..symbol import Symbol as _Symbol
+
+        if isinstance(x, _Symbol):
+            if self._feature is not None:
+                raise NotImplementedError(
+                    "ShardedEmbedding feature selection has no symbolic "
+                    "path — export the surrounding block with "
+                    "feature=None inputs")
+            return F.Embedding(x, embed_table, **self._kwargs)
+        if self._sparse_grad and isinstance(x, NDArray) \
+                and isinstance(embed_table, NDArray) and is_recording():
+            from ..ops.indexing import sparse_embedding
+
+            ids = x if self._feature is None \
+                else _from_jax(x._data[..., self._feature])
+            return sparse_embedding(ids, embed_table)
+        if self._feature is None:
+            xx = x
+        elif isinstance(x, NDArray):
+            xx = _from_jax(x._data[..., self._feature])
+        else:
+            xx = x[..., self._feature]
+        return F.Embedding(xx, embed_table, **self._kwargs)
+
+    def __repr__(self):
+        return "{name}({i} -> {o}, {dt}{sp})".format(
+            name=self.__class__.__name__, i=self._input_dim,
+            o=self._output_dim, dt=self.weight.dtype,
+            sp=", sparse_grad" if self._sparse_grad else "")

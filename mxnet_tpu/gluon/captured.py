@@ -46,13 +46,25 @@ ride on the same `lax.cond` branches as the eager grouped programs, and
 the host still performs EXACTLY one readback per step, after the update
 dispatch (`numerics.StepGuard`).
 
-What cannot be captured falls back to the eager oracle, silently and
-per-step: non-hybridized blocks, optimizers outside the fused-plan
-table, row-sparse/multi-precision params, remat-enabled blocks,
-kvstore-backed reduction (`kvstore.captured_step_compatible`), batch
-sizes not divisible by ``grad_accum``, and steps with a pending
-``nan_grad`` fault injection (the poison has no gradient buffer to
-land in on the captured path).
+Row-sparse embedding gradients (PR 18) run INSIDE the program too, for
+`embedding.ShardedEmbedding` tables under SGD/Adam lazy updates: the
+host computes unique ids + inverse index per step (`embedding.prep`),
+pads the unique count to a power-of-two bucket folded into the capture
+key, and the program pre-gathers just the touched rows, differentiates
+through a gather-by-inverse lookup, and scatters the row update back
+with `optimizer.grouped.sparse_row_kernel` — still one dispatch + one
+readback.  ``MXTPU_SPARSE_CAPTURED=0`` pins sparse configs to the
+eager row-sparse oracle.
+
+What cannot be captured falls back to the eager oracle, per-step:
+non-hybridized blocks, optimizers outside the fused-plan table,
+multi-precision params, remat-enabled blocks, kvstore-backed reduction
+(`kvstore.captured_step_compatible`), batch sizes not divisible by
+``grad_accum``, sparse tables under a pipeline schedule or overflowing
+a fixed MXTPU_UNIQUE_BUCKET, and steps with a pending ``nan_grad``
+fault injection (the poison has no gradient buffer to land in on the
+captured path).  A sparse fallback is never silent — the trainer emits
+a ``sparse_fallback{reason}`` telemetry event.
 """
 
 from __future__ import annotations
@@ -231,10 +243,13 @@ def ineligible_reason(trainer, block, loss_fn, data, grad_accum):
         return "grad_accum < 1"
     if data.shape[0] % k != 0:
         return f"batch {data.shape[0]} not divisible by grad_accum {k}"
-    for p in trainer._params:
-        if p._grad_req != "null" and \
-                getattr(p, "_grad_stype", None) == "row_sparse":
-            return "row-sparse gradients"
+    sparse = [(i, p) for i, p in enumerate(trainer._params)
+              if p._grad_req != "null"
+              and getattr(p, "_grad_stype", None) == "row_sparse"]
+    if sparse:
+        from .. import embedding as _embedding
+
+        return _embedding.sparse_capture_reason(trainer, block, sparse)
     return None
 
 
@@ -326,6 +341,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     from .. import numerics
     from ..optimizer import grouped as _grouped
 
+    trainer._sparse_fallback_reason = None
     reason = ineligible_reason(trainer, block, loss_fn, data, grad_accum)
     if reason is not None:
         return None
@@ -342,9 +358,17 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
         return None  # trainer optimizes params the forward never sees
     indices = [i for i, _p in trained]
     weights = [p.data() for _i, p in trained]
-    # weights stand in for the grads: the captured cotangents are cast
-    # to the parameter dtype, so groupability is decided by the weight
-    groups, fallback = _grouped.plan_items(upd, indices, weights, weights)
+    sparse_params = [(i, p) for i, p in trained
+                     if getattr(p, "_grad_stype", None) == "row_sparse"]
+    sparse_idx = {i for i, _p in sparse_params}
+    # weights stand in for the DENSE grads: the captured cotangents are
+    # cast to the parameter dtype, so groupability is decided by the
+    # weight.  Row-sparse params pass their actual RowSparseNDArray
+    # grad buffer so plan_items picks the sparse_row_kernel variant.
+    grad_standins = [p._grad if i in sparse_idx else p.data()
+                     for i, p in trained]
+    groups, fallback = _grouped.plan_items(upd, indices, grad_standins,
+                                           weights)
     if fallback:
         return None
 
@@ -372,6 +396,37 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
     # directly and via mesh_fp + the pp_microbatches program knob
     pp_stages, _pp_m, n_micro = resolve_pp_schedule(
         mesh, k, int(data.shape[0]))
+    # sparse-table host prep runs EVERY call, before the key: the
+    # padded unique-count bucket is part of the capture signature, so
+    # retraces are bounded by the number of distinct buckets a workload
+    # produces, not by per-batch unique counts
+    sparse_meta, sparse_key = [], ()
+    trainer._sparse_prep = None
+    if sparse_params:
+        if pp_stages > 1:
+            # gradients live in the 1F1B shifted carry; a rows-shaped
+            # pending slot per stage is a different schedule — decline
+            trainer._sparse_fallback_reason = \
+                "pipeline schedule with row-sparse tables"
+            return None
+        from .. import embedding as _embedding
+        from .. import telemetry as _telemetry
+
+        preps, why, lookup_us = _embedding.prepare_step(
+            block, data, sparse_params)
+        if preps is None:
+            trainer._sparse_fallback_reason = why
+            return None
+        pos = {i: j for j, (i, _p) in enumerate(trained)}
+        sparse_meta = [(pos[i], id(p)) for i, p in sparse_params]
+        sparse_key = tuple((pos[i], pr.bucket)
+                           for (i, _p), pr in zip(sparse_params, preps))
+        n_ids = sum(pr.n_ids for pr in preps)
+        _telemetry.note(
+            lookup_us=float(lookup_us),
+            unique_fraction=sum(pr.n_real for pr in preps)
+            / max(n_ids, 1))
+        trainer._sparse_prep = preps
     key = (
         id(block), _tree_version(block),
         id(loss_fn), _tree_version(loss_fn),
@@ -382,7 +437,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
         None if label is None else (tuple(label.shape),
                                     str(_raw(label).dtype)),
         _kvs.device_fingerprint(), mesh_fp,
-        pp_stages, n_micro,
+        pp_stages, n_micro, sparse_key,
         remat_policy, _tune_space.program_knob_values(),
         # integrity attestation adds a program output (the state
         # fingerprint) — a toggled flag must re-capture, and the
@@ -403,7 +458,7 @@ def get_step(trainer, block, loss_fn, data, label, grad_accum):
                         has_scaler=has_scaler, grad_accum=k,
                         has_label=label is not None, mesh=mesh,
                         remat=remat_policy, pp_stages=pp_stages,
-                        n_micro=n_micro)
+                        n_micro=n_micro, sparse_meta=sparse_meta)
     cap = capture_cache_size()
     while len(cache) >= cap:
         evicted_key = next(iter(cache))
@@ -433,7 +488,12 @@ class CapturedStep:
 
     def __init__(self, trainer, block, loss_fn, trained, groups,
                  guard_on, clip, has_scaler, grad_accum, has_label,
-                 mesh=None, remat=None, pp_stages=1, n_micro=None):
+                 mesh=None, remat=None, pp_stages=1, n_micro=None,
+                 sparse_meta=None):
+        # [(position in `trained`, table param id)] for row-sparse
+        # embedding tables whose lookup + update run in-program — the
+        # program then takes trailing (sp_uniq, sp_inv) index tuples
+        self._sparse = list(sparse_meta or [])
         # resolved remat policy (remat.py registry): checkpoint-style
         # policies wrap the per-microbatch forward+loss closure below;
         # 'save_every_k:N' instead applies inside the scanned trunk
@@ -524,6 +584,15 @@ class CapturedStep:
             train_shs = other_shs = None
         train_ids = [id(p) for _i, p in self._trained]
         train_dtypes = [p.data()._data.dtype for _i, p in self._trained]
+        # row-sparse tables: position in train_vals → slot in the
+        # trailing (sp_uniq, sp_inv) argument tuples
+        sparse_pos = [p for p, _pid in self._sparse]
+        sparse_param_ids = [pid for _p, pid in self._sparse]
+        sp_of = {p: j for j, p in enumerate(sparse_pos)}
+        from contextlib import nullcontext
+
+        if sparse_pos:
+            from ..embedding import prep as _embprep
         other_ids = [id(p) for _n, p in self._others]
         other_names = [n for n, _p in self._others]
         group_meta = []                 # (pure group fn, grad positions)
@@ -540,14 +609,21 @@ class CapturedStep:
 
         remat_policy = self._remat
 
-        def micro(train_vals, others, x_mb, y_mb, kb, kl, scale):
+        def micro(train_vals, others, x_mb, y_mb, kb, kl, scale,
+                  invs=()):
             base_pm = dict(zip(other_ids, others))
 
             def fwd(tv):
                 pm = dict(base_pm)
                 pm.update(zip(train_ids, tv))
                 aux = {}
-                with _blockmod.param_override_scope(pm, aux), \
+                # the capture scope hands ShardedEmbedding its
+                # microbatch inverse-index tracer; it must wrap the
+                # forward INSIDE fwd so a remat replay re-enters it
+                scope = _embprep.capture_scope(
+                    dict(zip(sparse_param_ids, invs))) if sparse_pos \
+                    else nullcontext()
+                with scope, _blockmod.param_override_scope(pm, aux), \
                         _ag.train_mode():
                     with _random.key_scope(kb):
                         out = blk.forward(x_mb)
@@ -589,19 +665,31 @@ class CapturedStep:
             return loss, gs, new_others
 
         def pure_step(train_vals, other_vals, state_vals, dyn_list,
-                      xs, ys, keys_b, keys_l, scale):
+                      xs, ys, keys_b, keys_l, scale, sp_uniq, sp_inv):
             global _TRACE_COUNT
             _TRACE_COUNT += 1  # python side effect: fires at trace only
+            # sparse tables enter the forward as their PRE-GATHERED
+            # unique rows (the out-of-range sentinel id clamps to the
+            # last row under mode='clip' — deterministic filler no
+            # inverse-index entry ever targets);
+            # the vjp below then differentiates w.r.t. the ROWS, so
+            # cotangents and the grad-accum carry are (bucket, dim)
+            # shaped, never the full table
+            lookup_vals = list(train_vals)
+            for j, p in enumerate(sparse_pos):
+                lookup_vals[p] = cut(jnp.take(
+                    train_vals[p], sp_uniq[j], axis=0, mode="clip"))
             if k == 1:
                 losses, grads, new_others = micro(
-                    train_vals, other_vals, xs, ys, keys_b, keys_l,
-                    scale)
+                    lookup_vals, other_vals, xs, ys, keys_b, keys_l,
+                    scale, list(sp_inv))
             elif not pp_sched:
                 def body(carry, sl):
                     acc, others = carry
                     loss, gs, others = micro(
-                        train_vals, others, sl["x"], sl.get("y"),
-                        sl["kb"], sl.get("kl"), scale)
+                        lookup_vals, others, sl["x"], sl.get("y"),
+                        sl["kb"], sl.get("kl"), scale,
+                        [sl[f"si{j}"] for j in range(len(sparse_pos))])
                     # one eager `grad += ct` dispatch per microbatch
                     acc = [cut(a + g) for a, g in zip(acc, gs)]
                     return (acc, others), loss
@@ -611,7 +699,9 @@ class CapturedStep:
                     sl["y"] = ys
                 if loss_keyed:
                     sl["kl"] = keys_l
-                acc0 = [jnp.zeros_like(v) for v in train_vals]
+                for j in range(len(sparse_pos)):
+                    sl[f"si{j}"] = sp_inv[j]
+                acc0 = [jnp.zeros_like(v) for v in lookup_vals]
                 (grads, new_others), losses = jax.lax.scan(
                     body, (acc0, list(other_vals)), sl)
             else:
@@ -652,14 +742,29 @@ class CapturedStep:
                 # cooldown drain: the last microbatch's grads are still
                 # in flight when the scan ends
                 grads = [cut(a + p) for a, p in zip(acc, pending)]
-            health = cut(numerics.health_of(grads)) if want_guard \
-                else None
+            if want_guard:
+                hg = grads
+                if sparse_pos:
+                    # the eager guard reads the DENSE gradient view
+                    # (RowSparseNDArray._data = zeros.at[ids].add(vals),
+                    # its own dispatch): same formula here, with the
+                    # out-of-bounds sentinel rows dropped by the scatter
+                    hg = list(grads)
+                    for j, p in enumerate(sparse_pos):
+                        hg[p] = cut(jnp.zeros(
+                            train_vals[p].shape,
+                            grads[p].dtype).at[sp_uniq[j]].add(grads[p]))
+                health = cut(numerics.health_of(hg))
+            else:
+                health = None
             new_train = list(train_vals)
             new_states = []
             for (gfn, pos), states, dyn in zip(group_meta, state_vals,
                                                dyn_list):
                 ws = [train_vals[p] for p in pos]
-                gsl = [grads[p] for p in pos]
+                # a row-sparse grad reaches its kernel as (ids, values)
+                gsl = [(sp_uniq[sp_of[p]], grads[p]) if p in sp_of
+                       else grads[p] for p in pos]
                 if want_guard:
                     nw, ns = gfn(ws, gsl, states, dyn, health)
                 else:
@@ -691,7 +796,8 @@ class CapturedStep:
         from .. import integrity as _integrity
 
         def pure_step_fp(train_vals, other_vals, state_vals, dyn_list,
-                         xs, ys, keys_b, keys_l, scale, attest):
+                         xs, ys, keys_b, keys_l, scale, sp_uniq,
+                         sp_inv, attest):
             # ``attest`` is STATIC: jit specializes into exactly two
             # executables (one trace + compile each, cached by jit).
             # The non-attest executable is the plain step plus a
@@ -702,7 +808,8 @@ class CapturedStep:
             # which blocks fusion/aliasing on EVERY step.)
             new_train, new_others, new_states, losses, health = \
                 pure_step(train_vals, other_vals, state_vals, dyn_list,
-                          xs, ys, keys_b, keys_l, scale)
+                          xs, ys, keys_b, keys_l, scale, sp_uniq,
+                          sp_inv)
             if attest:
                 flat_states = [a for group in new_states
                                for item in group for a in item]
@@ -714,7 +821,7 @@ class CapturedStep:
                     fp)
 
         return jax.jit(pure_step_fp, donate_argnums=(0, 1, 2),
-                       static_argnums=(9,))
+                       static_argnums=(11,))
 
     # -- per-step host driver ---------------------------------------------------
 
@@ -783,6 +890,32 @@ class CapturedStep:
                 if ys is not None:
                     ys = jax.device_put(ys, batch_sharding(
                         self._mesh, ys.shape[lead], leading=lead))
+            # host-prepared sparse lookup indices (get_step ran
+            # embedding.prepare_step before the cache lookup — possibly
+            # just consuming the DevicePrefetcher's stash); the inverse
+            # index reshapes to (n_micro, ids/micro) so each scan slice
+            # sees exactly its microbatch's flat ids, batch-major like
+            # the xs reshape above
+            sp_uniq = sp_inv = ()
+            if self._sparse:
+                preps = trainer._sparse_prep
+                trainer._sparse_prep = None
+                sp_uniq = tuple(jnp.asarray(pr.uniq) for pr in preps)
+                if k == 1:
+                    sp_inv = tuple(jnp.asarray(pr.inv) for pr in preps)
+                else:
+                    sp_inv = tuple(jnp.asarray(pr.inv.reshape(
+                        (k, pr.inv.size // k))) for pr in preps)
+                if self._mesh is not None:
+                    import jax
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec)
+
+                    repl = NamedSharding(self._mesh, PartitionSpec())
+                    sp_uniq = tuple(jax.device_put(u, repl)
+                                    for u in sp_uniq)
+                    sp_inv = tuple(jax.device_put(v, repl)
+                                   for v in sp_inv)
         scaler = getattr(trainer, "_amp_loss_scaler", None)
         scale = _np.float32(scaler.loss_scale if scaler else 1.0)
         train_raws = [p.data()._data for _i, p in self._trained]
@@ -793,7 +926,7 @@ class CapturedStep:
             if telemetry.enabled():
                 self._arg_specs = _arg_specs_of(
                     (train_raws, other_raws, state_vals, dyn_list,
-                     xs, ys, keys_b, keys_l, scale))
+                     xs, ys, keys_b, keys_l, scale, sp_uniq, sp_inv))
         fp = None
         with profiler.annotate("captured_step"):
             if self._want_fp:
@@ -801,14 +934,15 @@ class CapturedStep:
                 (new_train, new_others, new_states, losses, health,
                  fp) = self._fn(
                     train_raws, other_raws, state_vals, dyn_list,
-                    xs, ys, keys_b, keys_l, scale, attest)
+                    xs, ys, keys_b, keys_l, scale, sp_uniq, sp_inv,
+                    attest)
                 if not attest:
                     fp = None
             else:
                 new_train, new_others, new_states, losses, health = \
                     self._fn(
                         train_raws, other_raws, state_vals, dyn_list,
-                        xs, ys, keys_b, keys_l, scale)
+                        xs, ys, keys_b, keys_l, scale, sp_uniq, sp_inv)
         _DISPATCH_COUNT += 1
         for (_i, p), nw in zip(self._trained, new_train):
             p.data()._set_data(nw)

@@ -16,6 +16,17 @@ is how the overlap is verified (docs/perf.md "Input pipeline").
 ``MXTPU_DEVICE_PREFETCH=0`` (or ``depth=0``) disables the background
 thread entirely — batches are placed synchronously in the caller's
 thread, restoring fully synchronous legacy behavior.
+
+ID prefetch (PR 18): with ``sparse_tables=<block>`` the producer thread
+also dedupes the NEXT batch's embedding ids per `ShardedEmbedding`
+(`embedding.prep.prepare_one` — the dominant host cost of a captured
+sparse step) and stashes the result for `gluon/captured.py` to consume
+(`stash_prep`/`pop_prep`), so the unique/inverse work overlaps the
+CURRENT step's device compute.  With ``kvstore=`` and ``warm_pull=
+{key: out}`` it additionally issues `row_sparse_pull` for the next
+batch's rows from the producer thread — cold-row fetch overlapped with
+compute; the dist-kvstore push path (per-key ``bucketed_pushpull``,
+compression residuals) is untouched.
 """
 
 from __future__ import annotations
@@ -96,6 +107,20 @@ def _place(batch, mesh, axis):
     return batch
 
 
+def _batch_data(batch):
+    """The data tensor of a (placed) batch: a bare array, the first
+    element of a (data, label, ...) tuple/list, or ``DataBatch.data[0]``
+    — mirroring what `Trainer.train_step` receives as ``data``."""
+    if isinstance(batch, NDArray):
+        return batch
+    if isinstance(batch, (list, tuple)) and batch:
+        return batch[0] if isinstance(batch[0], NDArray) else None
+    d = getattr(batch, "data", None)
+    if isinstance(d, (list, tuple)) and d and isinstance(d[0], NDArray):
+        return d[0]
+    return None
+
+
 class _EndOfEpoch:
     pass
 
@@ -118,15 +143,64 @@ class DevicePrefetcher:
     mesh, axis :
         When given, batch arrays are placed with the data-parallel
         ``NamedSharding`` up front so the compiled step never reshards.
+    sparse_tables : Block, optional
+        A block tree containing `embedding.ShardedEmbedding` children:
+        the producer thread computes each table's unique ids + inverse
+        index for the batch it is about to yield and stashes them for
+        the captured step (`embedding.prep`), overlapping the id prep
+        with the current step's compute.
+    kvstore, warm_pull :
+        With a kvstore and ``warm_pull={key: out}``, the producer also
+        issues ``row_sparse_pull(key, out, row_ids=<next batch's
+        ids>)`` for every table whose parameter name matches ``key`` —
+        the cold-row fetch overlaps compute instead of stalling the
+        step.
     """
 
-    def __init__(self, data, depth=None, mesh=None, axis="dp"):
+    def __init__(self, data, depth=None, mesh=None, axis="dp",
+                 sparse_tables=None, kvstore=None, warm_pull=None):
         self._data = data
         self._depth = default_depth() if depth is None else int(depth)
         self._mesh = mesh
         self._axis = axis
+        self._sparse_block = sparse_tables
+        self._kvstore = kvstore
+        self._warm_pull = dict(warm_pull or {})
         self._stop = None
         self._thread = None
+
+    def _prep_sparse(self, placed):
+        """Producer-side id prep for the batch about to be yielded:
+        unique/inverse per sparse table (stashed for `pop_prep`) and the
+        optional warm `row_sparse_pull` of the rows it will touch."""
+        if self._sparse_block is None:
+            return
+        from ...embedding import prep as _prep
+
+        data = _batch_data(placed)
+        if data is None:
+            return
+        tables = _prep.find_sparse_embeddings(self._sparse_block)
+        if not tables:
+            return
+        t0 = _time.perf_counter()
+        preps = {}
+        for pid, blk in tables.items():
+            pr = _prep.prepare_one(data, blk)
+            if pr is not None:
+                preps[pid] = pr
+            if self._kvstore is not None:
+                dest = self._warm_pull.get(blk.weight.name)
+                if dest is not None:
+                    ids = pr.uniq[:pr.n_real] if pr is not None \
+                        else _np.unique(_prep.extract_ids(
+                            data, blk._feature, blk._input_dim))
+                    self._kvstore.row_sparse_pull(
+                        blk.weight.name, out=dest, row_ids=ids)
+        if preps:
+            _prep.stash_prep(data, preps)
+        telemetry.count("input.id_prep_us",
+                        int((_time.perf_counter() - t0) * 1e6))
 
     def __len__(self):
         return len(self._data)
@@ -183,6 +257,7 @@ class DevicePrefetcher:
             except StopIteration:
                 return
             placed = place(batch, self._mesh, self._axis)
+            self._prep_sparse(placed)
             telemetry.count(
                 "input.wait_us",
                 int((_time.perf_counter() - t0) * 1e6))
@@ -197,6 +272,7 @@ class DevicePrefetcher:
             try:
                 for batch in self._data:
                     placed = place(batch, self._mesh, self._axis)
+                    self._prep_sparse(placed)
                     if not _put(q, stop, placed):
                         return
                 _put(q, stop, _END)

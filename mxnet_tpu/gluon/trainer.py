@@ -230,6 +230,7 @@ class Trainer:
                         telemetry.note(
                             bubble_fraction=pstats["bubble_fraction"])
             else:
+                self._note_sparse_fallback(block, loss_fn, data, k)
                 result = self._eager_train_step(
                     block, loss_fn, data, label, batch_size, k,
                     ignore_stale_grad)
@@ -239,6 +240,33 @@ class Trainer:
         telemetry.step_end(acc, step=self._step_count,
                            skipped=len(self.skipped_steps) > n_skipped)
         return result
+
+    def _note_sparse_fallback(self, block, loss_fn, data, grad_accum):
+        """A sparse_grad=True model landing on the eager oracle is a
+        performance cliff (multi-dispatch, host-side coalesce) the user
+        explicitly tried to avoid — emit a ``sparse_fallback{reason}``
+        telemetry event rather than degrading silently.  Dense models
+        fall back silently as before."""
+        if not any(p._grad_req != "null"
+                   and getattr(p, "_grad_stype", None) == "row_sparse"
+                   for p in self._params):
+            return
+        from .. import resilience
+        from .. import telemetry
+        from . import captured as _captured
+        if not _captured.captured_step_enabled():
+            reason = "captured step disabled (MXTPU_CAPTURED_STEP=0)"
+        elif resilience.fault_armed("nan_grad") \
+                or resilience.fault_armed("bit_flip_grad"):
+            reason = "pending gradient fault injection"
+        else:
+            reason = getattr(self, "_sparse_fallback_reason", None)
+            self._sparse_fallback_reason = None
+            if reason is None:
+                reason = _captured.ineligible_reason(
+                    self, block, loss_fn, data, grad_accum) \
+                    or "capture declined"
+        telemetry.event("sparse_fallback", reason=reason)
 
     def _maybe_shard_batch(self, data, label):
         """When the parameters are committed over a multi-device mesh

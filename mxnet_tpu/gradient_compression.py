@@ -97,6 +97,47 @@ class GradientCompression:
         self._residual[key] = acc - h.astype(grad.dtype)
         return h
 
+    def quantize_rowsparse(self, key, ids, vals):
+        """Error feedback for a compact row-sparse gradient: quantize
+        over the UNION of this gradient's rows and the rows still owing
+        residual, and keep the residual itself compact — a row no batch
+        ever touched has exactly zero error and is never materialized
+        (the dense-view path would scatter threshold noise into cold
+        embedding rows).  Returns ``(union_ids, q_vals)``; rows whose
+        residual quantizes away are pruned from the carry."""
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(ids, jnp.int32)
+        vals = jnp.asarray(vals)
+        # coalesce duplicates and sort rows by id (searchsorted below
+        # needs sorted ids; jnp.unique returns them sorted)
+        uid, inv = jnp.unique(ids, return_inverse=True)
+        vals = jnp.zeros((uid.shape[0],) + vals.shape[1:],
+                         vals.dtype).at[inv.reshape(-1)].add(vals)
+        ids = uid
+        prev = self._residual.get(key)
+        if prev is None:
+            union, acc = ids, vals
+        else:
+            pids, pvals = prev
+            union = jnp.union1d(pids, ids)
+            acc = jnp.zeros((union.shape[0],) + vals.shape[1:],
+                            vals.dtype)
+            acc = acc.at[jnp.searchsorted(union, pids)].add(pvals)
+            acc = acc.at[jnp.searchsorted(union, ids)].add(vals)
+        if self.type == "fp16":
+            q = acc.astype(jnp.float16).astype(vals.dtype)
+        else:
+            _, _, q = self._threshold_quantize(acc, vals.dtype)
+        res = acc - q
+        owing = jnp.any(res != 0, axis=tuple(range(1, res.ndim)))
+        keep = jnp.nonzero(owing)[0]  # eager path: host sync is fine
+        if keep.shape[0]:
+            self._residual[key] = (union[keep], res[keep])
+        else:
+            self._residual.pop(key, None)
+        return union, q
+
     def codes(self, key, grad):
         """2bit only: quantize with error feedback and return PACKED uint8
         codes (4 values/byte) for the wire."""

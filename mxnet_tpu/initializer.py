@@ -92,6 +92,12 @@ class Initializer:
                 return
         if desc.endswith("weight"):
             self._init_weight(desc, arr)
+        elif desc.endswith("embed_table"):
+            # ShardedEmbedding's table (mxnet_tpu/embedding): a weight
+            # in every sense — named differently only so the row-shard
+            # overlay can claim it without colliding with the
+            # column-parallel ``embedding\d*_weight`` TP rule
+            self._init_weight(desc, arr)
         elif desc.endswith("bias"):
             self._init_bias(desc, arr)
         elif desc.endswith("gamma"):

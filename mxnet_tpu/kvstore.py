@@ -294,6 +294,15 @@ class KVStore:
                 merged = _from_jax(summed) if isinstance(merged, NDArray) \
                     else summed
             return merged
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+        if isinstance(merged, RowSparseNDArray) and not multi:
+            # compact error feedback: residuals live on touched rows
+            # only (quantizing the dense view would scatter threshold
+            # noise into cold embedding rows), and the result stays
+            # row-sparse so the lazy-row updater path is preserved
+            union, q = gc.quantize_rowsparse(
+                key, merged._rs_indices, merged._rs_values)
+            return row_sparse_array((q, union), shape=merged.shape)
         raw = merged._data if isinstance(merged, NDArray) else merged
         if multi and gc.type == "2bit":
             packed = gc.codes(key, raw)
@@ -460,7 +469,16 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             stored = self._store[k]
-            idx = jnp.unique(rid._data.astype(jnp.int32).reshape(-1))
+            # coalesce duplicate ids on the HOST before any device
+            # work: recommender batches repeat hot ids heavily, and a
+            # device-side unique would dispatch a program just to
+            # dedupe.  np.unique also sorts, which the searchsorted
+            # path below requires.
+            import numpy as _host_np
+
+            rid_host = _host_np.asarray(getattr(rid, "_data", rid))
+            idx = jnp.asarray(_host_np.unique(
+                rid_host.astype(_host_np.int32).reshape(-1)))
             if isinstance(stored, RowSparseNDArray):
                 # compact store: gather requested rows from the stored
                 # parts (absent rows pull zeros) — the dense `_data`

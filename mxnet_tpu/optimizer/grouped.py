@@ -236,6 +236,73 @@ def _plan_ftml(o, i, w, state):
             list(state), _dyn_ftml)
 
 
+# -- row-sparse (lazy-update) kernel wrappers ----------------------------------
+
+_SPARSE_KERNELS = {}
+
+
+def sparse_row_kernel(kernel):
+    """Row-sparse lazy-update variant of a dense update kernel.
+
+    The wrapped kernel sees ``grad`` as a ``(row_ids, row_values)`` pair:
+    it gathers the touched rows of the weight and every state, runs the
+    SAME elementwise dense kernel on just those rows (the exact call
+    `Optimizer._apply`'s eager sparse branch makes, including the
+    values-to-weight-dtype cast), and scatters the results back with
+    ``.at[ids].set``.  Untouched rows never enter the arithmetic, so
+    they stay bit-identical — lazy-update semantics.
+
+    Out-of-range ids are the captured step's padding convention
+    (sentinel id == vocab): the gather may fill those rows with
+    garbage, but JAX scatter DROPS out-of-bounds updates, so padded
+    rows write nothing.  One wrapper per dense kernel is cached so the
+    group key — ``(kernel, static_items, dtype)`` — stays stable across
+    plans and capture signatures."""
+    fn = _SPARSE_KERNELS.get(kernel)
+    if fn is None:
+        import jax.numpy as jnp
+
+        def row_step(weight, grad, *states, **kw):
+            ids, vals = grad
+            w_rows = jnp.take(weight, ids, axis=0)
+            s_rows = [jnp.take(s, ids, axis=0) for s in states]
+            res = kernel(w_rows, vals.astype(w_rows.dtype), *s_rows,
+                         **kw)
+            return (weight.at[ids].set(res[0]),
+                    *[s.at[ids].set(r) for s, r in zip(states,
+                                                       res[1:])])
+
+        row_step.__name__ = "row_sparse_" \
+            + getattr(kernel, "__name__", "kernel")
+        _SPARSE_KERNELS[kernel] = fn = row_step
+    return fn
+
+
+def _sparse_groupable(opt, weight, grad):
+    """Row-sparse items the grouped row kernel reproduces bitwise
+    against the eager sparse oracle: SGD/Adam lazy-update on a dense
+    float weight.  Everything else (other optimizers, lazy_update=False
+    densification, fp16 master weights) keeps the legacy per-parameter
+    path."""
+    from ..ndarray.sparse import RowSparseNDArray
+
+    if not isinstance(grad, RowSparseNDArray) \
+            or isinstance(weight, RowSparseNDArray):
+        return False
+    if type(opt) not in (_optmod.SGD, _optmod.Adam):
+        return False
+    if not getattr(opt, "lazy_update", True):
+        return False
+    import jax.numpy as jnp
+
+    w_raw = _raw(weight)
+    if not jnp.issubdtype(w_raw.dtype, jnp.floating):
+        return False
+    if opt.multi_precision and w_raw.dtype == _np.float16:
+        return False
+    return True
+
+
 # exact-type dispatch: a user SUBCLASS of a registered optimizer may
 # override update() arbitrarily, so it must take the legacy loop
 _PLANS = {
@@ -313,7 +380,12 @@ def build_group_step(kernel, static_items, guarded=False, clip=None):
                 kw[name] = col[j]
             g = grads[j]
             if coef is not None:
-                g = g * coef.astype(g.dtype)
+                if isinstance(g, tuple):
+                    # row-sparse (ids, values): clip scales the values,
+                    # ids pass through untouched
+                    g = (g[0], g[1] * coef.astype(g[1].dtype))
+                else:
+                    g = g * coef.astype(g.dtype)
             res = kernel(weights[j], g, *states[j], **kw)
             new_w.append(res[0])
             new_s.append(list(res[1:]))
@@ -429,6 +501,11 @@ def plan_items(updater, index, grad, weight):
         item = None
         if plan is not None and _groupable(o, w, g):
             item = plan(o, i, w, upd.states[i])
+        elif plan is not None and _sparse_groupable(o, w, g):
+            kernel, static, state_nds, dyn_fn = \
+                plan(o, i, w, upd.states[i])
+            item = (sparse_row_kernel(kernel), static, state_nds,
+                    dyn_fn)
         if item is None:
             fallback.append((i, g, w))
             continue
@@ -514,8 +591,14 @@ class GroupedUpdater:
         for gkey, items in groups.items():
             kernel, static_items = gkey[0], gkey[1]
             dtype = _raw(items[0][1]).dtype
+            from ..ndarray.sparse import RowSparseNDArray
+
             w_raws = [_raw(w) for _, w, _, _, _ in items]
-            g_raws = [_raw(g) for _, _, g, _, _ in items]
+            # row-sparse grads enter as (ids, values) pairs — NOT the
+            # dense ._data view, which would materialize the full table
+            g_raws = [(g._rs_indices, g._rs_values)
+                      if isinstance(g, RowSparseNDArray) else _raw(g)
+                      for _, _, g, _, _ in items]
             s_raws = [[_raw(s) for s in st] for _, _, _, st, _ in items]
             # host-side cast + STACK into one (n,) array per name so the
             # jit pytree carries 1 leaf per scalar name, not n (the

@@ -17,9 +17,10 @@ from . import mesh
 from .mesh import (DP, EP, PP, SP, TP, data_parallel_mesh, default_mesh,
                    make_mesh, set_default_mesh)
 from . import sharding
-from .sharding import (FSDPRules, MOE_EP_RULES, PPRules, ShardingRules,
-                       TRANSFORMER_TP_RULES, annotate_activations,
-                       annotate_block, batch_sharding, combined_rules,
+from .sharding import (EmbeddingRules, FSDPRules, MOE_EP_RULES, PPRules,
+                       ShardingRules, TRANSFORMER_TP_RULES,
+                       annotate_activations, annotate_block,
+                       batch_sharding, combined_rules, embedding_rules,
                        fsdp_rules, match_partition_rules, mesh_of_params,
                        param_sharding, pp_rules, shard_model)
 from . import ring

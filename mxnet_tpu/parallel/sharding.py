@@ -200,6 +200,44 @@ def pp_rules(mesh=None, axis=PP, axis_size=None, pattern=r"_stack_"):
     return PPRules(axis=axis, axis_size=axis_size, pattern=pattern)
 
 
+class EmbeddingRules(ShardingRules):
+    """Row-shard `embedding.ShardedEmbedding` tables: a COMPOSABLE
+    overlay claiming dim 0 (the vocab dim) of every ``*_embed_table``
+    parameter for ``axis`` (default the data axis).
+
+    Row sharding is the memory play for recommender-scale tables — the
+    vocab dim is the one that reaches hundreds of millions — and the
+    data axis is where the memory is: dp ranks otherwise hold identical
+    replicas.  The claim merges per-dim with TP/PP sets (PR 17), so an
+    explicit column rule on the output dim coexists: ('dp', 'tp').
+    Tables are named ``embed_table`` precisely so the
+    ``embedding\\d*_weight`` column-parallel rule in
+    `TRANSFORMER_TP_RULES` does not capture them whole-spec first.
+
+    No divisibility guard at the RULE level — the claim always lands,
+    so the spec stays stable while a deferred-init table's vocab is
+    still unknown.  Divisibility is `param_sharding`'s problem: a
+    committed placement cannot be uneven (jax.device_put rejects it),
+    so a vocab the axis does not divide degrades that dim to None
+    (replicated) at placement time, per mesh — the same table row-
+    shards on one layout and replicates on another, and the elastic
+    checkpoint plane carries it bitwise between the two.
+    """
+
+    composable = True
+
+    def __init__(self, axis=DP, pattern=r"_embed_table$"):
+        super().__init__(rules=[(pattern, (axis,))])
+        self.axis = axis
+
+
+def embedding_rules(axis=DP, pattern=r"_embed_table$"):
+    """`EmbeddingRules` — named constructor for symmetry with
+    `fsdp_rules` / `pp_rules` (no mesh binding needed: there is no
+    divisibility guard to size)."""
+    return EmbeddingRules(axis=axis, pattern=pattern)
+
+
 # default rule set for the transformer family (gluon/model_zoo/bert.py
 # parameter names)
 TRANSFORMER_TP_RULES = ShardingRules(rules=[
@@ -316,11 +354,21 @@ class _CombinedRules(ShardingRules):
                             "two rule sets may not assign different "
                             "axes to the same dim of the same param")
                 if e in merged and merged.index(e) != dim:
-                    raise ValueError(
-                        "combined_rules: axis {!r} claimed twice for "
-                        "{!r} (dims {} and {}) — a mesh axis shards at "
-                        "most one dim per param".format(
-                            e, name, merged.index(e), dim))
+                    prev = merged.index(e)
+                    if base_heur and prev not in claimed_dims:
+                        # the duplicate placement came from the FSDP
+                        # shape heuristic (e.g. it picked an embedding
+                        # table's divisible dim 1 when the vocab dim is
+                        # uneven): an explicit claim outranks it — drop
+                        # it and let the end-of-merge re-route look for
+                        # another dim
+                        merged[prev] = None
+                    else:
+                        raise ValueError(
+                            "combined_rules: axis {!r} claimed twice "
+                            "for {!r} (dims {} and {}) — a mesh axis "
+                            "shards at most one dim per param".format(
+                                e, name, prev, dim))
                 merged[dim] = e
                 claimed_dims.add(dim)
         if base_heur and base_set is not None:
@@ -380,7 +428,13 @@ def annotate_block(block, rules):
 
 
 def param_sharding(param, mesh):
-    """NamedSharding for a Parameter (replicated when no spec/axis)."""
+    """NamedSharding for a Parameter (replicated when no spec/axis).
+
+    Two leniencies so one rule set runs on every mesh: axes the mesh
+    doesn't have drop to None, and a sharded dim whose size the axis
+    does not divide drops to None too — `jax.device_put` rejects
+    uneven committed placements, and an uneven-vocab embedding table
+    must replicate rather than fail (`EmbeddingRules`)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     spec = param.partition_spec
@@ -389,8 +443,13 @@ def param_sharding(param, mesh):
     # drop axes the mesh doesn't have (lets the same rules run on a
     # dp-only mesh)
     cleaned = []
-    for entry in tuple(spec):
+    shape = getattr(param, "shape", None)
+    for dim, entry in enumerate(tuple(spec)):
         if entry is None or entry in mesh.shape:
+            if entry is not None and shape is not None \
+                    and dim < len(shape) \
+                    and shape[dim] % mesh.shape[entry] != 0:
+                entry = None
             cleaned.append(entry)
         else:
             cleaned.append(None)
@@ -444,26 +503,29 @@ def shard_model(block, mesh, mode="tp", rules=None, axis=DP,
 
     from .mesh import set_default_mesh
 
+    # every mode carries the EmbeddingRules overlay as a SIBLING set —
+    # composable claims must see the base's heuristic flag, so nesting
+    # an already-combined set would lose the FSDP re-route
+    emb = EmbeddingRules(axis=axis)
+    user = [] if rules is None else [rules]
     if mode == "fsdp":
-        base = fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)
-        rules = base if rules is None else combined_rules(rules, base)
+        sets = [emb] + user \
+            + [fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)]
     elif mode == "tp":
-        rules = TRANSFORMER_TP_RULES if rules is None else rules
+        sets = [emb, TRANSFORMER_TP_RULES] if rules is None \
+            else [emb] + user
     elif mode == "pp":
-        overlay = pp_rules(mesh=mesh)
-        rules = overlay if rules is None \
-            else combined_rules(overlay, rules)
+        sets = [pp_rules(mesh=mesh), emb] + user
     elif mode == "tp_pp":
-        base = TRANSFORMER_TP_RULES if rules is None else rules
-        rules = combined_rules(pp_rules(mesh=mesh), base)
+        sets = [pp_rules(mesh=mesh), emb,
+                TRANSFORMER_TP_RULES if rules is None else rules]
     elif mode == "pp_fsdp":
-        base = fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)
-        if rules is not None:
-            base = combined_rules(rules, base)
-        rules = combined_rules(pp_rules(mesh=mesh), base)
+        sets = [pp_rules(mesh=mesh), emb] + user \
+            + [fsdp_rules(mesh=mesh, axis=axis, min_size=min_size)]
     else:
         raise ValueError(f"shard_model: unknown mode {mode!r} (expected "
                          "'tp', 'fsdp', 'pp', 'tp_pp' or 'pp_fsdp')")
+    rules = combined_rules(*sets)
     from ..gluon.parameter import DeferredInitializationError
 
     specs = {}

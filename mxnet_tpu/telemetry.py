@@ -58,8 +58,12 @@ _LOCK = threading.Lock()
 # (the 1F1B schedule's idle share, in [0, 1)) next to mfu, and
 # ``collective_bytes_by_axis`` may grow a ``pp`` row; v1–v4 records
 # stay valid.
-SCHEMA_VERSION = 5
-_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5)
+# v6 (sparse embeddings): step records may carry ``lookup_us`` (host
+# id-prep time of a captured sparse step, microseconds, >= 0) and
+# ``unique_fraction`` (unique ids / total ids, in (0, 1]); v1–v5
+# records stay valid.
+SCHEMA_VERSION = 6
+_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -1197,4 +1201,13 @@ def validate_record(rec):
     if bf is not None and \
             (not isinstance(bf, (int, float)) or not 0 <= bf < 1):
         fail("bubble_fraction must be a number in [0, 1) or absent")
+    # optional sparse-embedding fields (schema v6): absent on dense steps
+    lu = rec.get("lookup_us")
+    if lu is not None and \
+            (not isinstance(lu, (int, float)) or lu < 0):
+        fail("lookup_us must be a non-negative number or absent")
+    uf = rec.get("unique_fraction")
+    if uf is not None and \
+            (not isinstance(uf, (int, float)) or not 0 < uf <= 1):
+        fail("unique_fraction must be a number in (0, 1] or absent")
     return rec

@@ -531,6 +531,83 @@ def test_gluon_trainer_checkpoint_roundtrip_sharded(tmp_path):
     parallel.set_default_mesh(None)
 
 
+def test_elastic_restore_row_sharded_table_bitwise(tmp_path):
+    """PR 18 acceptance: a row-sharded `ShardedEmbedding` table trained
+    on a ``dp=8`` mesh (6-row shards) restores BITWISE onto a
+    ``dp=2,tp=2`` layout (24-row shards, replicated over tp) through
+    the elastic template path — shard sizes differ across the layouts,
+    the bytes must not."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from mxnet_tpu import embedding, gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (forced-host) devices")
+
+    def build(seed, prefix):
+        mx.random.seed(seed)
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(embedding.ShardedEmbedding(48, 8),
+                    nn.Dense(3, in_units=8, flatten=False))
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    def table_of(tr):
+        (i, p), = [(i, p) for i, p in enumerate(tr._params)
+                   if p.name.endswith("embed_table")]
+        return i, p
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(5)
+    batches = [(rng.randint(0, 48, (16,)).astype(np.float32),
+                rng.randint(0, 3, (16,)).astype(np.float32))
+               for _ in range(4)]
+
+    # writer: table rows sharded 48/8 = 6 per device
+    src_net = build(11, "ckemb_")
+    src = gluon.Trainer(src_net.collect_params(), "adam",
+                        {"learning_rate": 1e-2})
+    parallel.shard_model(src_net, parallel.make_mesh(dp=8),
+                         mode="fsdp", min_size=1, trainer=src)
+    for x, y in batches[:2]:
+        src.train_step(src_net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    _, src_table = table_of(src)
+    src_jax = src_table.data()._data
+    assert src_jax.sharding.spec == PartitionSpec("dp", None)
+    assert src_jax.sharding.shard_shape(src_jax.shape) == (6, 8)
+    st = checkpoint.trainer_state(src)
+    frozen = [np.array(p, copy=True) for p in st["params"]]
+    _save_two_rank(tmp_path, 18, st)
+
+    # reader: different init + layout — 24-row shards over dp=2
+    dst_net = build(97, "ckemb2_")
+    dst = gluon.Trainer(dst_net.collect_params(), "adam",
+                        {"learning_rate": 1e-2})
+    parallel.shard_model(dst_net, parallel.make_mesh(dp=2, tp=2),
+                         mode="fsdp", min_size=1, trainer=dst)
+    x, y = batches[2]
+    dst.train_step(dst_net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    restored = ck.restore(
+        18, template=checkpoint.trainer_state_template(dst))
+    checkpoint.load_trainer_state(dst, restored)
+    ti, dst_table = table_of(dst)
+    dst_jax = dst_table.data()._data
+    assert dst_jax.sharding.shard_shape(dst_jax.shape) == (24, 8)
+    for p, want in zip(dst._params, frozen):
+        assert np.array_equal(p.data().asnumpy(), want)  # bitwise
+    assert dst._optimizer.num_update == int(st["num_update"])
+    # the restored table still trains row-sparse on the new layout
+    for x, y in batches[2:]:
+        dst.train_step(dst_net, loss_fn, mx.nd.array(x), mx.nd.array(y))
+    parallel.set_default_mesh(None)
+
+
 # -- integration: rollback / preemption / run_resilient / factory --------------
 
 def test_async_save_overlapped_with_rollback(tmp_path, monkeypatch):

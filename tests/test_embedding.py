@@ -1,0 +1,439 @@
+"""Sharded embeddings on the captured step (PR 18:
+mxnet_tpu/embedding/ + gluon/captured.py + optimizer/grouped.py).
+
+The captured sparse step must be a pure performance transform: host
+unique/inverse id prep, an in-program padded gather, and a segment-sum
+scatter-add row update — ONE dispatch + ONE readback per step, BITWISE
+equal to the eager row-sparse oracle (the op-by-op tape over
+`ops.indexing.sparse_embedding` + the RowSparseNDArray lazy-row
+updater), for sgd and adam, with and without grad accumulation,
+including rows the batch never touched.  Retraces are bounded by the
+power-of-2 unique-count bucket, and every routing of a
+``sparse_grad=True`` model to the eager oracle emits a
+``sparse_fallback{reason}`` telemetry event — never silent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import embedding, gluon, numerics, telemetry
+from mxnet_tpu.embedding import prep as emb_prep
+from mxnet_tpu.gluon import captured, nn
+from mxnet_tpu.optimizer import grouped
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TRACE_REPORT = os.path.join(_REPO, "tools", "trace_report.py")
+
+VOCAB, DIM, STEPS = 50, 8, 6
+
+
+def _make_net(hybridize, vocab=VOCAB, dim=DIM, seed=7):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(embedding.ShardedEmbedding(vocab, dim))
+        net.add(nn.Dense(3))
+    net.initialize(init=mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    return net
+
+
+def _batches(steps=STEPS, n=8, t=4, vocab=VOCAB, seed=3):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randint(0, vocab, size=(n, t)).astype(np.float32)
+          for _ in range(steps)]
+    ys = [rng.randint(0, 3, size=(n,)).astype(np.float32)
+          for _ in range(steps)]
+    return xs, ys
+
+
+def _state_leaves(state):
+    if state is None:
+        return []
+    if isinstance(state, (list, tuple)):
+        return [a for s in state for a in _state_leaves(s)]
+    return [state.asnumpy()] if hasattr(state, "asnumpy") else []
+
+
+def _events(kind):
+    with telemetry._LOCK:
+        return [r for r in telemetry._RECENT
+                if r.get("type") == "event" and r.get("event") == kind]
+
+
+def _run(monkeypatch, captured_on, opt="sgd", opt_params=None, k=1,
+         steps=STEPS, xs=None, ys=None):
+    """One full training run; captured = hybridized net through the
+    captured sparse step, eager = the NON-hybridized op-by-op oracle
+    behind MXTPU_SPARSE_CAPTURED=0."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED",
+                       "1" if captured_on else "0")
+    net = _make_net(hybridize=captured_on)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), opt,
+                            opt_params or {"learning_rate": 0.1})
+    if xs is None:
+        xs, ys = _batches(steps=steps)
+    captured.reset_counters()
+    losses = []
+    for s in range(steps):
+        loss = trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                                  mx.nd.array(ys[s]), grad_accum=k)
+        losses.append(loss.asnumpy())
+    weights = [p.data().asnumpy() for p in trainer._params]
+    states = {i: _state_leaves(st)
+              for i, st in trainer._updaters[0].states.items()}
+    return (losses, weights, states, captured.dispatch_count(),
+            captured.trace_count())
+
+
+# -- bitwise parity with the eager row-sparse oracle ---------------------------
+
+@pytest.mark.parametrize("opt,params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("adam", {"learning_rate": 0.01}),
+])
+@pytest.mark.parametrize("k", [1, 4])
+def test_captured_sparse_bitwise_equals_eager_oracle(monkeypatch, opt,
+                                                     params, k):
+    """Losses, EVERY weight (embedding rows the batches never touched
+    included — lazy-update must not decay them), and every optimizer
+    state leaf: bitwise equal between the captured sparse step and the
+    eager RowSparseNDArray oracle."""
+    le, we, se, _, _ = _run(monkeypatch, False, opt, params, k)
+    lc, wc, sc, disp, _ = _run(monkeypatch, True, opt, params, k)
+    assert disp == STEPS  # every step stayed captured
+    for s, (a, b) in enumerate(zip(le, lc)):
+        np.testing.assert_array_equal(a, b, err_msg=f"loss step {s}")
+    for i, (a, b) in enumerate(zip(we, wc)):
+        np.testing.assert_array_equal(a, b, err_msg=f"weight {i}")
+    assert set(se) == set(sc)
+    for i in se:
+        assert len(se[i]) == len(sc[i])
+        for a, b in zip(se[i], sc[i]):
+            np.testing.assert_array_equal(a, b, err_msg=f"state {i}")
+
+
+def test_untouched_rows_never_move(monkeypatch):
+    """Rows outside every batch's id set keep their init bytes: the
+    scatter-add update touches only gathered rows (lazy update), in
+    both modes."""
+    rng = np.random.RandomState(11)
+    # ids drawn from the first half of the vocab only
+    xs = [rng.randint(0, VOCAB // 2, size=(8, 4)).astype(np.float32)
+          for _ in range(STEPS)]
+    ys = [rng.randint(0, 3, size=(8,)).astype(np.float32)
+          for _ in range(STEPS)]
+    init = _make_net(hybridize=False)
+    table0 = init[0].weight.data().asnumpy().copy()
+    for cap in (False, True):
+        _, weights, _, _, _ = _run(monkeypatch, cap, "adam",
+                                   {"learning_rate": 0.01}, 1,
+                                   xs=xs, ys=ys)
+        table = weights[0]
+        np.testing.assert_array_equal(table[VOCAB // 2:],
+                                      table0[VOCAB // 2:])
+        assert not np.array_equal(table[:VOCAB // 2],
+                                  table0[:VOCAB // 2])
+
+
+# -- dispatch / readback / retrace accounting ----------------------------------
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_one_dispatch_one_readback_per_sparse_step(monkeypatch, k):
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    monkeypatch.setenv("MXTPU_GRAD_GUARD", "1")
+    net = _make_net(hybridize=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    rng = np.random.RandomState(5)
+    # every batch uses the same id set -> one bucket, zero retrace
+    # after warmup
+    ids = rng.choice(VOCAB, size=24, replace=False)
+    xs = [rng.choice(ids, size=(8, 4)).astype(np.float32)
+          for _ in range(5)]
+    ys = [rng.randint(0, 3, size=(8,)).astype(np.float32)
+          for _ in range(5)]
+    trainer.train_step(net, loss_fn, mx.nd.array(xs[0]),
+                       mx.nd.array(ys[0]), grad_accum=k)
+    captured.reset_counters()
+    grouped.reset_dispatch_count()
+    numerics.reset_readback_count()
+    for s in range(1, 5):
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]), grad_accum=k)
+    assert captured.dispatch_count() == 4
+    assert grouped.dispatch_count() == 0
+    assert numerics.readback_count() == 4
+    assert captured.trace_count() == 0
+
+
+def test_retrace_bounded_by_unique_buckets(monkeypatch):
+    """Varying per-batch unique counts retrace at most once per
+    DISTINCT power-of-2 bucket, not per batch."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    net = _make_net(hybridize=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rng = np.random.RandomState(9)
+    xs, ys, buckets = [], [], set()
+    for s in range(10):
+        # alternate small / large id sets -> two buckets at most
+        n_ids = 5 if s % 2 == 0 else 20
+        ids = rng.choice(VOCAB, size=n_ids, replace=False)
+        xs.append(rng.choice(ids, size=(8, 4)).astype(np.float32))
+        ys.append(rng.randint(0, 3, size=(8,)).astype(np.float32))
+        buckets.add(emb_prep.bucket_for(
+            len(np.unique(xs[-1].astype(np.int64)))))
+    captured.reset_counters()
+    for s in range(10):
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]))
+    assert captured.dispatch_count() == 10
+    assert captured.trace_count() <= len(buckets)
+    assert len(buckets) <= 3
+
+
+def test_step_records_carry_lookup_fields(monkeypatch):
+    """Schema v6: captured sparse steps stamp ``lookup_us`` and
+    ``unique_fraction`` into their StepStats records."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    telemetry.reset()
+    _run(monkeypatch, True, "sgd", {"learning_rate": 0.1}, 1, steps=3)
+    recs = telemetry.recent_steps(path="captured")
+    assert recs
+    for rec in recs[-2:]:
+        assert rec.get("lookup_us") is not None and rec["lookup_us"] >= 0
+        assert 0 < rec.get("unique_fraction") <= 1
+        telemetry.validate_record(rec)
+
+
+# -- sparse_fallback events: never silent --------------------------------------
+
+def test_fallback_event_when_sparse_capture_disabled(monkeypatch):
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "0")
+    telemetry.reset()
+    net = _make_net(hybridize=True)  # otherwise capture-eligible
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    xs, ys = _batches(steps=2)
+    captured.reset_counters()
+    for s in range(2):
+        trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                           mx.nd.array(ys[s]))
+    assert captured.dispatch_count() == 0
+    evs = _events("sparse_fallback")
+    assert len(evs) == 2
+    assert all("MXTPU_SPARSE_CAPTURED=0" in e["reason"] for e in evs)
+
+
+def test_fallback_event_on_non_lazy_update(monkeypatch):
+    """lazy_update=False densifies the row-sparse gradient — no fused
+    row plan; the eager oracle still trains, loudly."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    telemetry.reset()
+    net = _make_net(hybridize=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.1, "lazy_update": False})
+    xs, ys = _batches(steps=2)
+    captured.reset_counters()
+    for s in range(2):
+        loss = trainer.train_step(net, loss_fn, mx.nd.array(xs[s]),
+                                  mx.nd.array(ys[s]))
+        assert np.isfinite(loss.asnumpy()).all()
+    assert captured.dispatch_count() == 0  # routed to the oracle
+    evs = _events("sparse_fallback")
+    assert len(evs) == 2
+    assert all("lazy_update=False" in e["reason"] for e in evs)
+
+
+def test_fallback_event_on_bucket_overflow(monkeypatch):
+    """A fixed MXTPU_UNIQUE_BUCKET smaller than the batch's unique
+    count falls back per-step with the overflow reason."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    monkeypatch.setenv("MXTPU_UNIQUE_BUCKET", "8")
+    telemetry.reset()
+    net = _make_net(hybridize=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rng = np.random.RandomState(2)
+    small = rng.choice(VOCAB, size=6, replace=False)  # fits bucket 8
+    captured.reset_counters()
+    trainer.train_step(
+        net, loss_fn,
+        mx.nd.array(rng.choice(small, (8, 4)).astype(np.float32)),
+        mx.nd.array(rng.randint(0, 3, (8,)).astype(np.float32)))
+    assert captured.dispatch_count() == 1
+    trainer.train_step(  # 8 rows of 4 distinct ids each: > 8 unique
+        net, loss_fn,
+        mx.nd.array(np.arange(32, dtype=np.float32).reshape(8, 4)),
+        mx.nd.array(rng.randint(0, 3, (8,)).astype(np.float32)))
+    assert captured.dispatch_count() == 1  # overflow step went eager
+    evs = _events("sparse_fallback")
+    assert len(evs) == 1
+    assert "unique count exceeds MXTPU_UNIQUE_BUCKET=8" in \
+        evs[0]["reason"]
+
+
+# -- sharding: EmbeddingRules + placement --------------------------------------
+
+def test_embedding_rules_row_shard_and_user_merge():
+    """EmbeddingRules claims the vocab dim for dp; a user rule on the
+    output dim merges per-dim (PR 17) instead of colliding."""
+    from mxnet_tpu import parallel
+
+    rules = parallel.combined_rules(
+        parallel.EmbeddingRules(),
+        parallel.ShardingRules(rules=[(r"_embed_table$", (None, "tp"))]))
+    spec = parallel.match_partition_rules(
+        rules, {"net0_embed_table": (64, 16)})["net0_embed_table"]
+    assert tuple(spec) == ("dp", "tp")
+    # TRANSFORMER_TP_RULES' embedding\d*_weight rule must NOT claim it
+    spec2 = parallel.match_partition_rules(
+        parallel.combined_rules(parallel.EmbeddingRules(),
+                                parallel.TRANSFORMER_TP_RULES),
+        {"net0_embed_table": (64, 16)})["net0_embed_table"]
+    assert tuple(spec2) == ("dp", None)
+
+
+def test_uneven_vocab_degrades_to_replicated(mesh8):
+    """jax.device_put rejects uneven placements: a vocab the dp axis
+    does not divide must replicate at placement time, not fail."""
+    from types import SimpleNamespace
+
+    from jax.sharding import PartitionSpec
+
+    from mxnet_tpu import parallel
+
+    mesh = mesh8(dp=8)
+
+    def fake(shape):
+        return SimpleNamespace(partition_spec=PartitionSpec("dp", None),
+                               shape=shape)
+
+    even = parallel.param_sharding(fake((48, 8)), mesh)
+    assert even.spec == PartitionSpec("dp", None)
+    uneven = parallel.param_sharding(fake((51, 8)), mesh)
+    assert uneven.spec == PartitionSpec(None, None)
+
+
+def test_sharded_table_trains_captured(monkeypatch, mesh8):
+    """A row-sharded table trains through the captured sparse step on
+    an 8-device mesh: dispatch stays 1/step, the table keeps its
+    ('dp', None) placement, and the loss is finite."""
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    from jax.sharding import PartitionSpec
+
+    from mxnet_tpu import parallel
+
+    net = _make_net(hybridize=True, vocab=48)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    mesh = mesh8(dp=8)
+    specs = parallel.shard_model(net, mesh, mode="fsdp", min_size=1,
+                                 trainer=trainer)
+    table_name = [n for n in specs if n.endswith("embed_table")][0]
+    assert tuple(specs[table_name]) == ("dp", None)
+    rng = np.random.RandomState(3)
+    captured.reset_counters()
+    for _ in range(4):
+        x = rng.randint(0, 48, size=(16, 4)).astype(np.float32)
+        y = rng.randint(0, 3, size=(16,)).astype(np.float32)
+        loss = trainer.train_step(net, loss_fn, mx.nd.array(x),
+                                  mx.nd.array(y), grad_accum=2)
+        assert np.isfinite(loss.asnumpy()).all()
+    assert captured.dispatch_count() == 4
+    table = net[0].weight.data()._data
+    assert table.sharding.spec == PartitionSpec("dp", None)
+
+
+# -- prefetcher id-prep stage --------------------------------------------------
+
+def test_prefetcher_stashes_and_captured_consumes(monkeypatch):
+    """The producer-side id prep is stashed per batch tensor and
+    consumed (one-shot) by the captured step's own prepare_step."""
+    from mxnet_tpu.gluon.data.prefetcher import DevicePrefetcher
+
+    monkeypatch.setenv("MXTPU_SPARSE_CAPTURED", "1")
+    net = _make_net(hybridize=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    xs, ys = _batches(steps=4)
+    batches = [(mx.nd.array(x), mx.nd.array(y)) for x, y in zip(xs, ys)]
+    pf = DevicePrefetcher(batches, depth=2, sparse_tables=net)
+    captured.reset_counters()
+    n = 0
+    for x, y in pf:
+        # the producer thread stashed this batch's prep
+        key = id(net[0].weight)
+        trainer.train_step(net, loss_fn, x, y)
+        n += 1
+    pf.close()
+    assert n == 4
+    assert captured.dispatch_count() == 4
+    # stash fully drained: nothing left for any batch
+    for x, _ in batches:
+        assert emb_prep.pop_prep(x) is None
+
+
+def test_pop_prep_is_one_shot():
+    data = mx.nd.array(np.array([[1.0, 2.0], [3.0, 1.0]]))
+    blk = embedding.ShardedEmbedding(8, 4)
+    blk.initialize()
+    pr = emb_prep.prepare_one(data, blk)
+    assert pr is not None
+    emb_prep.stash_prep(data, {id(blk.weight): pr})
+    got = emb_prep.pop_prep(data)
+    assert got is not None and id(blk.weight) in got
+    assert emb_prep.pop_prep(data) is None
+
+
+# -- trace_report embeddings section -------------------------------------------
+
+def test_trace_report_embeddings_section(tmp_path, monkeypatch):
+    """A sparse run's event log flows through the trace_report CLI:
+    lookup/unique aggregates plus the per-reason fallback census, and
+    the v6 fields validate."""
+    path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", path)
+    telemetry.reset()
+    for step in range(3):
+        acc = telemetry.step_begin(path="captured")
+        telemetry.note(lookup_us=100.0 + step, unique_fraction=0.5)
+        telemetry.step_end(acc, step=step)
+    telemetry.event("sparse_fallback",
+                    reason="unique count exceeds MXTPU_UNIQUE_BUCKET=8")
+    telemetry.reset()  # close the sink
+
+    env = dict(os.environ)
+    env.pop("MXTPU_TELEMETRY_PATH", None)
+    proc = subprocess.run(
+        [sys.executable, _TRACE_REPORT, path, "--validate"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = proc.stdout
+    assert "embeddings:" in out
+    assert "lookup_us: mean 101.0" in out
+    assert "unique_fraction: mean 0.5000" in out
+    assert "sparse fallbacks: 1 step(s)" in out
+    assert "1x unique count exceeds MXTPU_UNIQUE_BUCKET=8" in out
+    assert "validate against schema" in out

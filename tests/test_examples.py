@@ -120,6 +120,12 @@ def test_example_dcgan():
     assert "dcgan OK" in out
 
 
+def test_example_train_dlrm():
+    out = _run("train_dlrm.py", "--steps", "40", "--batch-size", "64")
+    assert "dlrm OK" in out
+    assert "40 captured dispatches" in out  # sparse path stayed captured
+
+
 def test_example_matrix_factorization():
     out = _run("matrix_factorization.py", "--steps", "150", timeout=500)
     assert "matrix factorization OK" in out

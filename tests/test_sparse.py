@@ -556,3 +556,99 @@ def test_sparse_save_load_roundtrip(tmp_path):
     assert isinstance(back["c"], CSRNDArray)
     np.testing.assert_allclose(back["c"].asnumpy(), np.eye(4))
     np.testing.assert_allclose(back["d"].asnumpy(), d.asnumpy())
+
+
+# -- PR 18: duplicate-id pulls + compression on row-sparse grads ---------------
+
+def test_kvstore_row_sparse_pull_duplicate_numpy_ids():
+    """The pull coalesces duplicate row ids ON THE HOST before touching
+    the device (one gather, no device-side unique dispatch), and plain
+    numpy id arrays are accepted — the prefetcher's warm-pull path
+    hands over exactly that."""
+    kv = mx.kv.create("local")
+    table = np.arange(40, dtype=np.float32).reshape(10, 4)
+    kv.init(0, nd.array(table))
+    out = sp.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull(0, out=out,
+                       row_ids=np.array([7, 2, 7, 7, 2], np.int64))
+    assert out.num_stored_rows == 2
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2, 7])
+    np.testing.assert_array_equal(out.data.asnumpy(), table[[2, 7]])
+    # bitwise identical to the already-unique pull
+    out2 = sp.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull(0, out=out2, row_ids=nd.array([2.0, 7.0]))
+    np.testing.assert_array_equal(out.data.asnumpy(),
+                                  out2.data.asnumpy())
+
+
+def _rs_grad(vals, ids, shape):
+    return row_sparse_array(
+        (np.asarray(vals, np.float32), list(ids)), shape=shape)
+
+
+@pytest.mark.parametrize("gc_type,threshold", [("2bit", 0.5),
+                                               ("fp16", 0.5)])
+def test_compression_rowsparse_error_feedback_bitwise(gc_type,
+                                                      threshold):
+    """2bit/fp16 on RowSparseNDArray gradients: the quantized push is
+    BITWISE equal to a numpy oracle of the error-feedback recurrence,
+    the residual stays compact (touched rows only — cold rows never
+    materialize error), and rows owing residual are re-emitted on later
+    rounds even when the new batch misses them."""
+    shape = (12, 2)
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": gc_type,
+                                 "threshold": threshold})
+    kv.init("emb", nd.zeros(shape))
+
+    rounds = [
+        ([[0.7, -0.1], [0.2, 0.9]], [1, 4]),
+        ([[0.4, 0.4]], [4]),               # row 1 only owes residual
+        ([[0.3, -0.8], [0.05, 0.0]], [1, 9]),
+    ]
+    residual = {}  # oracle: row id -> np residual row
+
+    def oracle(vals, ids):
+        acc = {i: np.array(r, np.float32)
+               for i, r in residual.items()}
+        for r, i in zip(np.asarray(vals, np.float32), ids):
+            acc[i] = acc.get(i, np.zeros(shape[1], np.float32)) + r
+        out = {}
+        residual.clear()
+        for i, a in acc.items():
+            if gc_type == "fp16":
+                q = a.astype(np.float16).astype(np.float32)
+            else:
+                q = np.where(a >= threshold, np.float32(threshold),
+                             np.where(a <= -threshold,
+                                      np.float32(-threshold),
+                                      np.float32(0.0)))
+            out[i] = q
+            res = a - q
+            if np.any(res != 0):
+                residual[i] = res
+        return out
+
+    touched = set()
+    for vals, ids in rounds:
+        touched.update(ids)
+        kv.push("emb", _rs_grad(vals, ids, shape))
+        want_rows = oracle(vals, ids)
+        got = nd.zeros(shape)
+        kv.pull("emb", out=got)
+        want = np.zeros(shape, np.float32)
+        for i, q in want_rows.items():
+            want[i] = q
+        np.testing.assert_array_equal(got.asnumpy(), want)
+        # the store-side residual mirrors the oracle's, compactly
+        gc = kv._compression
+        if residual:
+            ids_kept, res_kept = gc._residual["emb"]
+            np.testing.assert_array_equal(
+                np.asarray(ids_kept), sorted(residual))
+            for row, i in zip(np.asarray(res_kept),
+                              sorted(residual)):
+                np.testing.assert_array_equal(row, residual[i])
+            assert set(int(i) for i in np.asarray(ids_kept)) <= touched
+        else:
+            assert "emb" not in gc._residual

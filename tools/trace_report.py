@@ -135,10 +135,11 @@ def report_run(run, records, out):
             out.write(f"  skipped steps: {len(skipped)} "
                       f"(ids {ids})\n")
         report_pipeline(steps, out)
+    kinds = {}
+    for e in events:
+        kinds.setdefault(e.get("event", "?"), []).append(e)
+    report_embeddings(steps, kinds, out)
     if events:
-        kinds = {}
-        for e in events:
-            kinds.setdefault(e.get("event", "?"), []).append(e)
         out.write("  events:\n")
         for kind in sorted(kinds):
             group = kinds[kind]
@@ -177,6 +178,46 @@ def report_pipeline(steps, out):
     if pp_bytes:
         out.write(f"    pp hand-off: mean {_mean(pp_bytes):.0f} "
                   f"bytes/step/device\n")
+
+
+def report_embeddings(steps, kinds, out):
+    """Sparse-embedding section (docs/perf.md "Sharded embeddings"):
+    host id-prep time and unique-id fraction of the captured sparse
+    steps (schema v6 ``lookup_us``/``unique_fraction`` fields), plus
+    every ``sparse_fallback`` event with its reason — a sparse model
+    landing on the eager oracle is a performance cliff and never
+    silent.  Prints nothing for dense runs."""
+    lookups = [s.get("lookup_us") for s in steps
+               if s.get("lookup_us") is not None]
+    fallbacks = kinds.get("sparse_fallback", ())
+    if not lookups and not fallbacks:
+        return
+    out.write("  embeddings:\n")
+    if lookups:
+        out.write(f"    lookup_us: mean {_mean(lookups):.1f}  "
+                  f"p50 {_pctl(lookups, 50):.1f}  "
+                  f"p99 {_pctl(lookups, 99):.1f} "
+                  f"over {len(lookups)} step(s)\n")
+        shares = [s["lookup_us"] / s["wall_us"] for s in steps
+                  if s.get("lookup_us") is not None
+                  and s.get("wall_us")]
+        if shares:
+            out.write(f"    lookup stall share: mean "
+                      f"{_mean(shares):.4f} of step wall time\n")
+        fracs = [s.get("unique_fraction") for s in steps
+                 if s.get("unique_fraction") is not None]
+        if fracs:
+            out.write(f"    unique_fraction: mean {_mean(fracs):.4f}  "
+                      f"min {min(fracs):.4f}  max {max(fracs):.4f}\n")
+    if fallbacks:
+        reasons = {}
+        for e in fallbacks:
+            reasons[e.get("reason", "?")] = \
+                reasons.get(e.get("reason", "?"), 0) + 1
+        out.write(f"    sparse fallbacks: {len(fallbacks)} step(s) ran "
+                  f"the eager oracle\n")
+        for reason in sorted(reasons):
+            out.write(f"      {reasons[reason]}x {reason}\n")
 
 
 def report_integrity(kinds, attestations, out):
