@@ -32,6 +32,7 @@ SURVEY §5.3).
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import pickle
@@ -335,12 +336,18 @@ class AsyncCheckpointer:
 
     # -- save ------------------------------------------------------------------
 
-    def save(self, step, state):
+    def save(self, step, state, data_state=None):
         """Snapshot ``state`` to host and return; serialization, fsync,
         and the cross-host commit run on a background writer (unless
         ``async_save=False``).  At most ONE save is outstanding: a new
         ``save()`` first blocks on the previous commit (backpressure),
-        and any error the writer hit is raised here or in ``wait()``."""
+        and any error the writer hit is raised here or in ``wait()``.
+
+        ``data_state`` (optional): a JSON-serializable input-pipeline
+        ``state_dict()`` (see gluon/data/state.py).  Captured here,
+        synchronously — the pipeline keeps advancing while the writer
+        runs — and stamped into MANIFEST.json with a CRC so restore
+        resumes at the exact sample offset."""
         # everything before save() returns — backpressure join, host
         # snapshot, sync commit — stalls the train loop; the async
         # writer's work after that does not
@@ -348,14 +355,15 @@ class AsyncCheckpointer:
         self._join(raise_error=True)
         leaves, skeleton = _flatten(state)
         mine, metas = self._snapshot_local(leaves)
+        ds = None if data_state is None else copy.deepcopy(data_state)
         if not self.async_save:
             with resilience.guard_checkpoint(f"ckpt_save:{step}"):
-                self._commit(step, mine, metas, skeleton)
+                self._commit(step, mine, metas, skeleton, ds)
             self._count_stall(t0)
             return step
         self._pending_step = step
         self._thread = threading.Thread(
-            target=self._writer, args=(step, mine, metas, skeleton),
+            target=self._writer, args=(step, mine, metas, skeleton, ds),
             name=f"ckpt_writer:{step}", daemon=True)
         self._thread.start()
         self._count_stall(t0)
@@ -388,7 +396,7 @@ class AsyncCheckpointer:
                     if isinstance(arr, np.ndarray) else np.asarray(arr)
         return mine, metas
 
-    def _writer(self, step, mine, metas, skeleton):
+    def _writer(self, step, mine, metas, skeleton, data_state=None):
         timeout = os.environ.get("MXTPU_CKPT_TIMEOUT")
         # dump-only watchdog: a hung filesystem in the WRITER thread
         # surfaces as stack dumps now and an error at the train thread's
@@ -397,7 +405,7 @@ class AsyncCheckpointer:
             float(timeout), name=f"async_ckpt:{step}",
             action="none").start() if timeout else None
         try:
-            self._commit(step, mine, metas, skeleton)
+            self._commit(step, mine, metas, skeleton, data_state)
         except BaseException as e:          # noqa: BLE001
             with self._lock:
                 self._error = e
@@ -405,7 +413,7 @@ class AsyncCheckpointer:
             if wd is not None:
                 wd.cancel()
 
-    def _commit(self, step, mine, metas, skeleton):
+    def _commit(self, step, mine, metas, skeleton, data_state=None):
         """Phase 1: durable local shard + rank entry.  Barrier.
         Phase 2: rank 0 atomically renames MANIFEST.json."""
         sdir = self._step_dir(step)
@@ -430,13 +438,15 @@ class AsyncCheckpointer:
             # peer RAM replica rides the writer thread: the host shard
             # copy already exists, so the extra cost is one pickle+send
             buddy = (self.rank + 1) % self.world_size
-            self._peer_store.hold_own(step, mine)
+            payload = mine if data_state is None else \
+                _peer_wrap(mine, data_state)
+            self._peer_store.hold_own(step, payload)
             if buddy != self.rank:
-                self._peer_store.send_to(buddy, step, mine)
+                self._peer_store.send_to(buddy, step, payload)
         self._barrier(f"ckpt_shards_{step}")
         resilience.maybe_crash("crash_before_manifest")
         if self.rank == 0:
-            self._write_manifest(step, sdir, skeleton)
+            self._write_manifest(step, sdir, skeleton, data_state)
             self._corrupt_shard_fault(sdir)
         self._barrier(f"ckpt_commit_{step}")
         if self.rank == 0:
@@ -466,7 +476,7 @@ class AsyncCheckpointer:
             if every is None else every)
         return self
 
-    def _write_manifest(self, step, sdir, skeleton):
+    def _write_manifest(self, step, sdir, skeleton, data_state=None):
         shards, leaf_meta = [], {}
         for r in range(self.world_size):
             epath = os.path.join(sdir, self._entry_name(r))
@@ -500,6 +510,11 @@ class AsyncCheckpointer:
             # time — restore audits it back to the chain (optional key,
             # same manifest version: old readers ignore it)
             manifest["integrity"] = stamp
+        if data_state is not None:
+            # input-pipeline resume point (optional key, same manifest
+            # version: manifests without it restore exactly as before)
+            manifest["data_state"] = resilience.data_state_stamp(
+                data_state)
         mpath = os.path.join(sdir, "MANIFEST.json")
         with open(mpath + ".tmp", "w") as f:
             json.dump(manifest, f)
@@ -688,6 +703,29 @@ class AsyncCheckpointer:
         self._load_leaves(step, m)
         return m
 
+    def data_state(self, step=None):
+        """The input-pipeline ``state_dict`` stamped into ``step``'s
+        manifest (latest committed step when None), or None when the
+        checkpoint predates data-state stamping — restore stays backward
+        compatible.  A present-but-corrupt stamp raises
+        `CheckpointCorrupt` (fail closed: silently resuming at the wrong
+        sample offset is the one outcome this subsystem exists to
+        prevent).  When the step only exists as a peer-RAM snapshot
+        (elastic recovery beat the disk manifest), falls through to this
+        rank's own held wrap in the attached `PeerSnapshotStore`."""
+        self._join(raise_error=False)
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        mpath = os.path.join(self._step_dir(step), "MANIFEST.json")
+        if not os.path.exists(mpath):
+            if self._peer_store is not None:
+                return self._peer_store.data_state_at(self.rank, step)
+            return None
+        m = self._manifest(step)
+        return resilience.data_state_unstamp(m.get("data_state"))
+
     # -- listing ---------------------------------------------------------------
 
     def all_steps(self):
@@ -759,6 +797,24 @@ _PEER_MAGIC = b"MXTPSNP1"
 #: epoch u32, crc u32, payload_len u64
 _PEER_HDR = "<BIQIIQ"
 _PEER_PUT, _PEER_GET = 1, 2
+
+
+_PEER_WRAP_KEY = "__mxt_peer_wrap__"
+
+
+def _peer_wrap(state, data_state):
+    """Bundle a snapshot with its input-pipeline state for peer
+    replication.  The wrapper is a plain dict so `snapshot_to_host`
+    walks it unchanged; unwrapping is transparent (`_peer_unwrap`), so
+    stores holding bare pre-wrap snapshots keep working."""
+    return {_PEER_WRAP_KEY: 1, "state": state, "data_state": data_state}
+
+
+def _peer_unwrap(obj):
+    """(state, data_state) from a possibly-wrapped peer payload."""
+    if isinstance(obj, dict) and obj.get(_PEER_WRAP_KEY) == 1:
+        return obj.get("state"), obj.get("data_state")
+    return obj, None
 
 
 def _recv_exact(conn, n):
@@ -925,7 +981,20 @@ class PeerSnapshotStore:
     def own_at(self, step):
         with self._lock:
             held = self._held.get(self.rank, {}).get(int(step))
-        return pickle.loads(held[1]) if held is not None else None
+        if held is None:
+            return None
+        return _peer_unwrap(pickle.loads(held[1]))[0]
+
+    def data_state_at(self, from_rank, step):
+        """The input-pipeline state riding ``from_rank``'s held snapshot
+        at ``step``, or None (bare pre-wrap snapshot / nothing held).
+        Every rank stamps the same GLOBAL pipeline state, so a survivor
+        reads its own held wrap — no network fetch needed."""
+        with self._lock:
+            held = self._held.get(int(from_rank), {}).get(int(step))
+        if held is None:
+            return None
+        return _peer_unwrap(pickle.loads(held[1]))[1]
 
     def held_steps(self, from_rank, epoch=None):
         with self._lock:
@@ -1022,12 +1091,14 @@ class PeerSnapshotStore:
                 f"peer snapshot rank {from_rank} step {step} from "
                 f"holder {holder_rank}: checksum mismatch")
         telemetry.count("peer_snap.fetches")
-        return pickle.loads(blob)
+        return _peer_unwrap(pickle.loads(blob))[0]
 
     def _local_fetch(self, from_rank, step):
         with self._lock:
             held = self._held.get(int(from_rank), {}).get(int(step))
-        return pickle.loads(held[1]) if held is not None else None
+        if held is None:
+            return None
+        return _peer_unwrap(pickle.loads(held[1]))[0]
 
 
 def _apply_template(state, template, path="$"):

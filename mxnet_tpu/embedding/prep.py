@@ -224,6 +224,14 @@ def pop_prep(data_nd):
     return entry[1]
 
 
+def clear_stash():
+    """Drop every stashed prep.  Called on pipeline restore / prefetcher
+    teardown: a pre-crash batch's prep must never pair with a
+    post-restore batch (the strong refs would also pin the dead epoch's
+    batches in memory)."""
+    _PREP_CACHE.clear()
+
+
 # -- capture-trace plumbing ----------------------------------------------------
 #
 # While gluon/captured.py traces a sparse step it maps each table

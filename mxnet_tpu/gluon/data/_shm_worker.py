@@ -17,12 +17,39 @@ pickling through the queue, which is always correct, merely slower.
 
 from __future__ import annotations
 
+import os as _os
+import time as _time
 import traceback
 
 import numpy as _np
 
 # slot offsets are aligned so every leaf view starts on a cache line
 _ALIGN = 64
+
+
+def _maybe_data_fault(batch_idx):
+    """stdlib mirror of ``resilience.maybe_data_fault`` for spawn
+    workers (this module must stay importable without the package):
+    parses ``MXTPU_FAULT_INJECT`` directly for the two input-pipeline
+    sites — ``worker_hang:K`` (the fetch of batch K sleeps
+    ``MXTPU_DATA_HANG_SECS``, long past any receive timeout) and
+    ``data_skew:K`` (fetches of the first K batches each sleep
+    ``MXTPU_DATA_SKEW_SECS``)."""
+    spec = _os.environ.get("MXTPU_FAULT_INJECT")
+    if not spec:
+        return
+    for item in spec.split(","):
+        site, _, arg = item.strip().partition(":")
+        try:
+            k = int(arg) if arg else 0
+        except ValueError:
+            continue
+        if site == "worker_hang" and k == int(batch_idx):
+            _time.sleep(float(_os.environ.get("MXTPU_DATA_HANG_SECS",
+                                              10.0)))
+        elif site == "data_skew" and int(batch_idx) < k:
+            _time.sleep(float(_os.environ.get("MXTPU_DATA_SKEW_SECS",
+                                              0.05)))
 
 
 def _leaf_np(x):
@@ -129,6 +156,7 @@ def worker_loop(dataset, batchify_fn, slots, task_q, result_q):
             return
         batch_idx, slot_id, samples = task
         try:
+            _maybe_data_fault(batch_idx)
             batch = [dataset[i] for i in samples]
             if batchify_fn is None:
                 ok = _collate_into_slot(batch, slots[slot_id])

@@ -24,16 +24,21 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import collections
 import multiprocessing
 import os
 import queue as _queue
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as _np
 
+from ... import resilience as _resilience
+from ... import telemetry as _telemetry
 from ...ndarray.ndarray import NDArray, _from_jax
 from . import sampler as _sampler
 from . import _shm_worker
+from .state import DataPipelineState
 
 
 class DataLoaderWorkerError(RuntimeError):
@@ -99,7 +104,11 @@ class _Worker:
         self._dataset = dataset
         self._batchify_fn = batchify_fn
 
-    def __call__(self, samples):
+    def __call__(self, samples, batch_idx=None):
+        if batch_idx is not None:
+            # worker_hang:K / data_skew:K fault sites (thread transport;
+            # _shm_worker mirrors this for spawn workers)
+            _resilience.maybe_data_fault(batch_idx)
         return self._batchify_fn([self._dataset[i] for i in samples])
 
 
@@ -111,16 +120,52 @@ class DataLoader:
     num_workers, pin_memory (ignored: XLA host buffers are already pinned),
     prefetch (None -> 2*num_workers; 0 -> at most one batch in flight),
     thread_pool.
+
+    TPU-first additions (exactly-once resumable pipeline, see
+    ``gluon/data/state.py``):
+
+    - ``seed``: opting in makes the loader **resumable** — the sample
+      order becomes a pure function of ``(seed, epoch)``, the loader
+      exposes ``state_dict()/load_state_dict()`` (epoch, global sample
+      cursor, quarantined batches) for the checkpoint path, and replay
+      after a `DivergenceMonitor` rollback skips quarantined batches
+      with one ``batch_quarantined`` telemetry event each.
+    - ``rank``/``world_size``: this loader's slice of the global order
+      (``order[cursor:][rank::world]``).  A restored state keeps the
+      LOCAL rank/world, so an elastic N→M reshape re-shards the
+      remaining epoch deterministically with zero re-read and zero
+      skipped samples.
+    - ``MXTPU_DATA_TIMEOUT`` (seconds, default = ``timeout``): receive
+      watchdog for worker batches — a hung worker raises
+      `DataLoaderWorkerError` naming the batch instead of blocking the
+      training step past the gang's heartbeat window.
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=True, timeout=120):
+                 prefetch=None, thread_pool=True, timeout=120,
+                 seed=None, rank=0, world_size=1):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
         self._timeout = timeout
+        self._state = None
+
+        if seed is not None:
+            if batch_sampler is not None or sampler is not None:
+                raise ValueError(
+                    "seed= (resumable loading) builds its own sampler; "
+                    "it cannot be combined with sampler= or "
+                    "batch_sampler=")
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified with seed=")
+            self._state = DataPipelineState(
+                len(dataset), seed=seed, shuffle=shuffle,
+                rank=rank, world=world_size)
+            sampler = _sampler.ResumableSampler(self._state)
+            shuffle = False   # the ResumableSampler owns the order
 
         if batch_sampler is None:
             if batch_size is None:
@@ -157,17 +202,64 @@ class DataLoader:
             self._batchify_fn = batchify_fn
 
     def __iter__(self):
+        if self._state is None:
+            return self._raw_iter(iter(self._batch_sampler))
+        return _ResumableIter(self)
+
+    def _raw_iter(self, batches):
+        """The transport-level iterator over an index-batch stream."""
         if self._num_workers == 0:
             def same_process_iter():
-                for batch in self._batch_sampler:
+                for batch in batches:
                     ret = self._batchify_fn(
                         [self._dataset[idx] for idx in batch])
                     yield ret
             return same_process_iter()
-        return _MultiWorkerIter(self)
+        return _MultiWorkerIter(self, batches)
 
     def __len__(self):
         return len(self._batch_sampler)
+
+    # -- resumable pipeline state (gluon/data/state.py) ------------------------
+
+    def _require_state(self, what):
+        if self._state is None:
+            raise RuntimeError(
+                f"DataLoader.{what}: construct the loader with seed= to "
+                f"make it resumable")
+        return self._state
+
+    def state_dict(self):
+        """JSON-serializable pipeline position (delivery-exact: never
+        counts prefetched-but-undelivered batches)."""
+        return self._require_state("state_dict").state_dict()
+
+    def load_state_dict(self, sd):
+        """Adopt a checkpointed position; the next ``__iter__`` resumes
+        at the exact sample offset (zero re-read, zero skipped).  The
+        loader's own rank/world are kept — loading an N-rank state into
+        an M-rank loader IS the elastic re-shard."""
+        st = self._require_state("load_state_dict")
+        st.load_state_dict(sd)
+        _telemetry.event("data_resume", epoch=st.epoch, cursor=st.cursor,
+                         samples_seen=st.samples_seen,
+                         reread_samples=0, skipped_samples=0,
+                         world=st.world, loader_rank=st.rank)
+        return self
+
+    def quarantine(self, batch_ids):
+        """Mark ``(epoch, batch_idx)`` batch ids to be skipped (loudly)
+        on replay — the `DivergenceMonitor` rollback hookup."""
+        self._require_state("quarantine").quarantine(batch_ids)
+
+    def last_batch_id(self):
+        """``(epoch, batch_idx)`` of the newest delivered batch (what
+        the Trainer reports to `DivergenceMonitor.observe`)."""
+        return self._require_state("last_batch_id").last_delivered
+
+    @property
+    def samples_seen(self):
+        return self._require_state("samples_seen").samples_seen
 
 
 def _slot_bytes():
@@ -188,9 +280,16 @@ class _MultiWorkerIter:
     as a context manager.
     """
 
-    def __init__(self, loader):
+    def __init__(self, loader, batches=None):
         self._loader = loader
-        self._batches = iter(loader._batch_sampler)
+        self._batches = iter(loader._batch_sampler) if batches is None \
+            else iter(batches)
+        # receive watchdog: how long a delivery may wait on one worker
+        # result before declaring it hung (default: the transport
+        # timeout) — keeps a wedged worker from stalling step_tick past
+        # the gang's heartbeat window
+        self._data_timeout = float(
+            os.environ.get("MXTPU_DATA_TIMEOUT", loader._timeout))
         self._depth = max(1, loader._prefetch)
         self._sent_idx = 0
         self._rcvd_idx = 0
@@ -238,7 +337,7 @@ class _MultiWorkerIter:
         if batch is None:
             return
         if self._pool is not None:
-            fut = self._pool.submit(self._worker, batch)
+            fut = self._pool.submit(self._worker, batch, self._sent_idx)
             self._data_buffer[self._sent_idx] = ("future", fut, batch)
         else:
             slot = self._free_slots.pop()
@@ -253,12 +352,16 @@ class _MultiWorkerIter:
         slow one is pending."""
         while idx not in self._data_buffer:
             try:
-                msg = self._result_q.get(timeout=self._loader._timeout)
+                msg = self._result_q.get(timeout=self._data_timeout)
             except _queue.Empty:
-                self.close()
+                alive = [p.pid for p in self._procs if p.is_alive()]
+                self.close(wait=False)
+                self._note_timeout(idx)
                 raise DataLoaderWorkerError(
                     f"DataLoader worker result for batch {idx} not "
-                    f"received within timeout={self._loader._timeout}s")
+                    f"received within MXTPU_DATA_TIMEOUT="
+                    f"{self._data_timeout}s (hung worker? live worker "
+                    f"pids: {alive})")
             tag, bidx, slot, payload, is_list = msg
             if tag == "shm":
                 out = _shm_worker.read_slot(self._slots[slot], payload,
@@ -287,7 +390,15 @@ class _MultiWorkerIter:
             self._rcvd_idx += 1
             self._push_next()
             try:
-                out = fut.result(timeout=self._loader._timeout)
+                out = fut.result(timeout=self._data_timeout)
+            except _FutTimeout as err:
+                self.close(wait=False)
+                self._note_timeout(idx)
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker thread hung on batch {idx} "
+                    f"(sample indices {list(samples)}): no result "
+                    f"within MXTPU_DATA_TIMEOUT="
+                    f"{self._data_timeout}s") from err
             except Exception as err:
                 self.close()
                 raise DataLoaderWorkerError(
@@ -313,20 +424,27 @@ class _MultiWorkerIter:
 
     # -- cleanup ---------------------------------------------------------------
 
-    def close(self):
-        """Cancel pending work and release threads/processes/queues."""
+    @staticmethod
+    def _note_timeout(idx):
+        _telemetry.event("data_worker_timeout", batch=int(idx))
+
+    def close(self, wait=True):
+        """Cancel pending work and release threads/processes/queues.
+        ``wait=False`` (the hung-worker watchdog path) skips blocking
+        joins — waiting on the very worker that just timed out would
+        turn the watchdog into the hang it exists to break."""
         if self._closed:
             return
         self._closed = True
         if self._pool is not None:
-            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool.shutdown(wait=wait, cancel_futures=True)
         for _ in self._procs:
             try:
                 self._task_q.put(None)
             except (ValueError, OSError):
                 pass
         for p in self._procs:
-            p.join(timeout=5)
+            p.join(timeout=5 if wait else 0.1)
             if p.is_alive():
                 p.terminate()
                 p.join(timeout=1)
@@ -341,6 +459,127 @@ class _MultiWorkerIter:
             self.close()
         except Exception:
             pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _ResumableIter:
+    """Delivery-time sample accounting + quarantine-honoring replay.
+
+    Wraps the transport iterator (same-process generator or
+    `_MultiWorkerIter`) of a seeded DataLoader.  The batch *plan* — the
+    submission-ordered stream of index batches — is generated lazily
+    from the `ResumableSampler` and tagged with each batch's global
+    ordinal; quarantined ordinals are dropped from the plan (never
+    fetched — a poisoned batch must not be decoded, let alone trained
+    on).  The shared `DataPipelineState` advances only when a batch is
+    actually DELIVERED here (prefetched-but-undelivered work is
+    invisible to a checkpoint), with any preceding quarantine skips
+    accounted — and announced via one ``batch_quarantined`` telemetry
+    event each — in exact delivery order.
+
+    A wrapper that prefetches FURTHER downstream (`DevicePrefetcher`)
+    calls ``defer_accounting()``: each delivery then queues a commit
+    *token* instead of applying it, and the wrapper commits the token
+    when the batch finally reaches ITS consumer — so the state is
+    delivery-exact at the outermost layer, and tokens for batches a
+    teardown discards are simply never committed.
+    """
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._state = loader._state
+        # submission-ordered ("skip"|"deliver", ordinal, n_samples)
+        # events, drained in delivery order by _drain()
+        self._events = collections.deque()
+        self._inner = loader._raw_iter(self._plan())
+        self._done = False
+        self._deferred = False
+        self._tokens = collections.deque()
+
+    def _plan(self):
+        st = self._state
+        epoch, ordinal = st.epoch, st.batch_idx
+        for batch in self._loader._batch_sampler:
+            quarantined = st.is_quarantined(epoch, ordinal)
+            self._events.append(
+                ("skip" if quarantined else "deliver", ordinal,
+                 len(batch)))
+            ordinal += 1
+            if not quarantined:
+                yield batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        try:
+            out = next(self._inner)
+        except StopIteration:
+            self._finish_epoch()
+            raise
+        self._settle(self._drain(stop_after_deliver=True))
+        return out
+
+    def _drain(self, stop_after_deliver):
+        token = []
+        while self._events:
+            ev = self._events.popleft()
+            token.append(ev)
+            if stop_after_deliver and ev[0] == "deliver":
+                break
+        return token
+
+    def _settle(self, token):
+        if self._deferred:
+            self._tokens.append(token)
+        else:
+            self.commit(token)
+
+    def _finish_epoch(self):
+        # trailing events can only be quarantine skips (every deliver
+        # event precedes its batch's delivery)
+        token = self._drain(stop_after_deliver=False)
+        token.append(("epoch_end",))
+        self._settle(token)
+        self._done = True
+
+    # -- deferred accounting (DevicePrefetcher) --------------------------------
+
+    def defer_accounting(self):
+        """Queue commit tokens instead of applying them: the caller is
+        prefetching ahead of the real consumer and will ``commit`` each
+        token at downstream delivery time."""
+        self._deferred = True
+        return self
+
+    def take_token(self):
+        return self._tokens.popleft() if self._tokens else None
+
+    def commit(self, token):
+        st = self._state
+        for ev in token or ():
+            if ev[0] == "skip":
+                _, ordinal, n = ev
+                st.skip(n)
+                _telemetry.event("batch_quarantined", epoch=st.epoch,
+                                 batch=int(ordinal), samples=int(n))
+            elif ev[0] == "deliver":
+                st.advance(ev[2])
+            else:   # "epoch_end"
+                st.next_epoch()
+
+    def close(self):
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
 
     def __enter__(self):
         return self

@@ -232,6 +232,16 @@ class DevicePrefetcher:
         except Exception:
             pass
 
+    def _clear_prep(self):
+        """Invalidate the PR 18 id-prefetch stash: preps computed for
+        batches that will never be consumed (shutdown, restore,
+        exception teardown) must not survive into the next epoch."""
+        if self._sparse_block is None:
+            return
+        from ...embedding import prep as _prep
+
+        _prep.clear_stash()
+
     def _shutdown(self):
         if self._stop is not None:
             self._stop.set()
@@ -239,6 +249,41 @@ class DevicePrefetcher:
             self._thread.join(timeout=5)
         self._stop = None
         self._thread = None
+        self._clear_prep()
+
+    # -- resumable pipeline state (gluon/data/state.py) ------------------------
+
+    def state_dict(self):
+        """The wrapped source's position.  Delivery-exact by
+        construction: the source's cursor advances when *this* wrapper
+        delivers a batch downstream, not when the producer thread
+        prefetches it."""
+        return self._data.state_dict()
+
+    def load_state_dict(self, sd):
+        """Restore never consumes a stale pre-crash batch: the producer
+        thread is stopped and its in-flight (already-placed) batches
+        and id-prep stash discarded BEFORE the source adopts the new
+        cursor — the next ``__iter__`` re-fetches from the restored
+        offset."""
+        self._shutdown()
+        self._data.load_state_dict(sd)
+        return self
+
+    def quarantine(self, batch_ids):
+        """Delegate to the wrapped loader (see DataLoader.quarantine)."""
+        return self._data.quarantine(batch_ids)
+
+    def last_batch_id(self):
+        """(epoch, batch_idx) of the last batch DELIVERED downstream
+        (deferred accounting commits at the consumer side of the
+        prefetch queue, so a batch the producer merely prefetched does
+        not count), or None."""
+        return self._data.last_batch_id()
+
+    @property
+    def samples_seen(self):
+        return self._data.samples_seen
 
     def __iter__(self):
         self._shutdown()
@@ -267,15 +312,27 @@ class DevicePrefetcher:
     def _async_iter(self):
         q = _queue.Queue(maxsize=self._depth)
         stop = threading.Event()
+        # resumable sources (seeded DataLoader): the producer runs ahead
+        # of the training loop, so sample accounting is deferred — each
+        # batch travels with its commit token and the state advances
+        # only when the CONSUMER below delivers the batch downstream.
+        # Tokens of batches a teardown discards are never committed.
+        src = iter(self._data)
+        acct = src if hasattr(src, "defer_accounting") else None
+        if acct is not None:
+            acct.defer_accounting()
 
         def producer():
             try:
-                for batch in self._data:
+                for batch in src:
                     placed = place(batch, self._mesh, self._axis)
                     self._prep_sparse(placed)
-                    if not _put(q, stop, placed):
+                    token = acct.take_token() if acct is not None \
+                        else None
+                    if not _put(q, stop, (placed, token)):
                         return
-                _put(q, stop, _END)
+                token = acct.take_token() if acct is not None else None
+                _put(q, stop, (_END, token))
             except BaseException as err:  # forwarded to the consumer
                 _put(q, stop, err)
 
@@ -300,13 +357,16 @@ class DevicePrefetcher:
                 telemetry.count(
                     "input.wait_us",
                     int((_time.perf_counter() - t0) * 1e6))
-                if item is _END:
-                    return
                 if isinstance(item, BaseException):
                     raise item
+                placed, token = item
+                if acct is not None and token is not None:
+                    acct.commit(token)   # delivery-time accounting
+                if placed is _END:
+                    return
                 telemetry.count("input.batches")
                 telemetry.gauge_set("input.queue_depth", q.qsize())
-                yield item
+                yield placed
         finally:
             stop.set()
             while not q.empty():  # unblock a producer stuck on put
@@ -315,6 +375,9 @@ class DevicePrefetcher:
                 except _queue.Empty:
                     break
             t.join(timeout=5)
+            # exception/abandon path: in-flight batches above were
+            # discarded uncommitted; their stashed preps go with them
+            self._clear_prep()
             if self._thread is t:
                 self._stop, self._thread = None, None
 

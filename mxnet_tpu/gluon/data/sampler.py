@@ -38,6 +38,35 @@ class RandomSampler(Sampler):
         return self._length
 
 
+class ResumableSampler(Sampler):
+    """Seeded, shardable, checkpoint-resumable sample order.
+
+    NEW, TPU-first (no reference analog): draws from a shared
+    :class:`~mxnet_tpu.gluon.data.state.DataPipelineState` — each
+    ``__iter__`` yields THIS rank's slice of the epoch's *remaining*
+    sample space (``order[cursor:][rank::world]``; see state.py for the
+    exactness model).  The epoch order is a pure function of
+    ``(seed, epoch)``, never the global RNG, so a restored or reshaped
+    gang reconstructs the identical order.  The cursor itself is
+    advanced by the delivering iterator (DataLoader), not here:
+    sampling runs ahead of delivery under prefetch, and the checkpoint
+    must record what was delivered.
+    """
+
+    def __init__(self, state):
+        self._state = state
+
+    @property
+    def state(self):
+        return self._state
+
+    def __iter__(self):
+        return iter(self._state.shard().tolist())
+
+    def __len__(self):
+        return self._state.shard_len()
+
+
 class FilterSampler(Sampler):
     def __init__(self, fn, dataset):
         self._fn = fn

@@ -67,6 +67,10 @@ class Trainer:
         self.divergence_monitor = None
         self.skipped_steps = []
         self._step_count = 0
+        # resumable input pipeline (gluon/data/state.py): when attached,
+        # each guarded step tags the divergence monitor with the batch
+        # that fed it, so a rollback can quarantine the poisoned batch
+        self._data_pipeline = None
         # integrity plane (mxnet_tpu/integrity.py): attach_integrity
         # makes the captured step fingerprint the state every
         # plane.every steps and attest it against the gang
@@ -446,6 +450,28 @@ class Trainer:
             for i, g, w in updates:
                 self._updaters[0](i, g, w)
 
+    def attach_data_pipeline(self, pipeline):
+        """Attach a resumable input pipeline (a ``DataLoader`` built
+        with ``seed=``, or a ``DevicePrefetcher`` wrapping one).  The
+        guarded step then (a) passes the just-delivered batch id to the
+        divergence monitor — a rollback quarantines the streak's
+        batches so replay skips them — and (b) notes ``samples_seen``
+        on each step's telemetry record.  Also wired into an attached
+        ``divergence_monitor`` so its rollback rewinds the pipeline to
+        the restored checkpoint's sample offset.  Returns self."""
+        self._data_pipeline = pipeline
+        if self.divergence_monitor is not None:
+            self.divergence_monitor.data_pipeline = pipeline
+        return self
+
+    def _batch_ids(self):
+        """[(epoch, batch_idx)] of the last-delivered batch, or None."""
+        p = self._data_pipeline
+        if p is None:
+            return None
+        bid = p.last_batch_id()
+        return None if bid is None else [bid]
+
     # -- integrity plane plumbing (mxnet_tpu/integrity.py) ---------------------
 
     def attach_integrity(self, plane):
@@ -506,7 +532,8 @@ class Trainer:
             # scaler wants the scalars
             if monitor is not None:
                 monitor.observe(step=self._step_count,
-                                grad_norm=guard.grad_norm, healthy=True)
+                                grad_norm=guard.grad_norm, healthy=True,
+                                batch_indices=self._batch_ids())
             self._note_guard_scalars(guard, scaler)
             self._integrity_attest(guard.fingerprint)
             return
@@ -529,7 +556,8 @@ class Trainer:
             self._scale = 1.0 / scaler.loss_scale
         if monitor is not None:
             monitor.observe(step=self._step_count,
-                            grad_norm=guard.grad_norm, healthy=healthy)
+                            grad_norm=guard.grad_norm, healthy=healthy,
+                            batch_indices=self._batch_ids())
         self._note_guard_scalars(guard, scaler)
         self._integrity_attest(guard.fingerprint)
 
@@ -547,6 +575,9 @@ class Trainer:
                            else float("nan"))
         if scaler is not None:
             telemetry.note(loss_scale=scaler.loss_scale)
+        if self._data_pipeline is not None:
+            telemetry.note(samples_seen=int(
+                self._data_pipeline.samples_seen))
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference: Trainer.save_states)."""

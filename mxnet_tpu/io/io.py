@@ -297,6 +297,56 @@ class PrefetchingIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    # -- resumable pipeline state ----------------------------------------------
+
+    def _quiesce(self):
+        """Wait until every prefetch thread parks (data_ready set):
+        the child iterators are then untouched until data_taken."""
+        for e in self.data_ready:
+            e.wait()
+
+    def state_dict(self):
+        """Checkpointable position, exact at the DELIVERY point.  Each
+        prefetch thread may hold one fetched-but-undelivered batch in
+        ``next_batch[i]``; its child cursor is rolled back one batch in
+        the recorded state, so restore re-fetches that batch instead of
+        skipping it (the held batch itself is never serialized)."""
+        self._quiesce()
+        children = []
+        for i, it in enumerate(self.iters):
+            st = it.state_dict()
+            if self.next_batch[i] is not None:
+                st = dict(st)
+                st["cursor"] = int(st["cursor"]) - self.batch_size
+            children.append(st)
+        return {"version": 1, "iters": children}
+
+    def load_state_dict(self, sd):
+        """Restore: the in-flight prefetched batches are DISCARDED (they
+        belong to the pre-restore position) and the threads re-fetch
+        from each child's restored cursor."""
+        if not isinstance(sd, dict) or sd.get("version") != 1:
+            raise ValueError(
+                f"PrefetchingIter.load_state_dict: unsupported state "
+                f"{type(sd).__name__} (want version-1 dict)")
+        children = sd.get("iters")
+        if not isinstance(children, list) or \
+                len(children) != self.n_iter:
+            raise ValueError(
+                f"PrefetchingIter.load_state_dict: state has "
+                f"{len(children) if isinstance(children, list) else '?'} "
+                f"child iters, this prefetcher drives {self.n_iter}")
+        self._quiesce()
+        for it, st in zip(self.iters, children):
+            it.load_state_dict(st)
+        for i in range(self.n_iter):
+            self.next_batch[i] = None
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return self
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize data into list of (name, numpy) (reference: io._init_data)."""
@@ -479,6 +529,43 @@ class NDArrayIter(DataIter):
         _np.random.shuffle(self.idx)
         self.data = _getdata_by_idx(self.data, self.idx)
         self.label = _getdata_by_idx(self.label, self.idx)
+
+    # -- resumable pipeline state ----------------------------------------------
+
+    def state_dict(self):
+        """Exact position: the epoch's permutation (``idx`` — the data
+        is physically reordered by it, so it IS the epoch order) plus
+        the cursor.  JSON-serializable; rides the checkpoint manifest
+        via `AsyncCheckpointer.save(..., data_state=...)`."""
+        return {"version": 1, "cursor": int(self.cursor),
+                "idx": [int(i) for i in self.idx]}
+
+    def load_state_dict(self, sd):
+        """Adopt a recorded position with zero re-read and zero skipped
+        samples.  The data is currently ordered by ``self.idx``; the
+        recorded epoch order is ``sd['idx']`` — a RELATIVE permutation
+        re-orders in place (``argsort(current)[wanted]``), so restore
+        never needs the original un-shuffled arrays."""
+        if not isinstance(sd, dict) or sd.get("version") != 1:
+            raise ValueError(
+                f"NDArrayIter.load_state_dict: unsupported state "
+                f"{type(sd).__name__} (want version-1 dict)")
+        want = _np.asarray(sd["idx"], dtype=_np.int64)
+        if want.shape[0] != self.num_data or \
+                not _np.array_equal(_np.sort(want),
+                                    _np.arange(self.num_data)):
+            raise ValueError(
+                f"NDArrayIter.load_state_dict: state permutes "
+                f"{want.shape[0]} samples, iterator holds "
+                f"{self.num_data} (or idx is not a permutation)")
+        rel = _np.argsort(self.idx)[want]
+        self.data = _getdata_by_idx(self.data, rel)
+        self.label = _getdata_by_idx(self.label, rel)
+        self.idx = want
+        self.cursor = int(sd["cursor"])
+        self._cache_data = None
+        self._cache_label = None
+        return self
 
 
 def _array(np_arr):

@@ -337,6 +337,12 @@ class DivergenceMonitor:
         self.quarantined = []
         self._streak_batches = []
         self._last_step = None
+        # optional resumable input pipeline (an object with
+        # load_state_dict/quarantine, e.g. gluon.data.DataLoader built
+        # with seed=): rollback rewinds it to the restored checkpoint's
+        # sample offset and quarantines the streak's batches so replay
+        # skips them (one `batch_quarantined` event per skip)
+        self.data_pipeline = None
 
     def _is_bad(self, loss, grad_norm, healthy):
         if not healthy:
@@ -415,6 +421,18 @@ class DivergenceMonitor:
                 self.scaler.loss_scale = max(
                     1.0, self.scaler.loss_scale / self.scaler.scale_factor)
             self.scaler._unskipped = 0
+        if self.data_pipeline is not None:
+            ds_fn = getattr(self.checkpointer, "data_state", None)
+            ds = ds_fn(restored) if ds_fn is not None else None
+            if ds is not None:
+                # rewind the pipeline to the checkpoint's exact sample
+                # offset FIRST (load replaces the quarantine set), then
+                # quarantine the streak so replay skips the poison
+                self.data_pipeline.load_state_dict(ds)
+            bad_ids = [tuple(b) for b in batches
+                       if isinstance(b, (list, tuple)) and len(b) == 2]
+            if bad_ids:
+                self.data_pipeline.quarantine(bad_ids)
         self.recoveries += 1
         self.logger.warning(
             "divergence auto-recovery #%d: rolled back to checkpoint step "

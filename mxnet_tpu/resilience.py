@@ -82,6 +82,13 @@ Fault-injection sites (``MXTPU_FAULT_INJECT="site:arg,site:arg"``):
 - ``bad_core:K``         — rank K's step input is perturbed so its
                           compute is deterministically wrong (compute
                           SDC; replay audit classifies it)
+- ``worker_hang:K``      — the DataLoader worker fetching batch K hangs
+                          (``MXTPU_DATA_HANG_SECS``, far past any
+                          receive timeout) — the ``MXTPU_DATA_TIMEOUT``
+                          watchdog must name the batch, not block
+- ``data_skew:K``        — fetches of the first K batches each sleep
+                          ``MXTPU_DATA_SKEW_SECS`` (input-skew
+                          straggler injection)
 
 Elastic gang recovery (PR 8) also lives here: :class:`HeartbeatPublisher`
 / :class:`FailureDetector` / :class:`StragglerMonitor` form the health
@@ -94,6 +101,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import json
 import os
 import pickle
 import random as _random
@@ -188,7 +196,12 @@ class _FaultPlan:
                 # LocalCheckpointer files (verify-after-write coverage)
                 self.counts[site] = int(arg) if arg else 1
             elif site in ("corrupt_record", "sigterm_at_step",
-                          "corrupt_shard"):
+                          "corrupt_shard", "worker_hang", "data_skew"):
+                # worker_hang: the loader worker fetching batch K
+                # sleeps MXTPU_DATA_HANG_SECS (one-shot) — exercises
+                # the MXTPU_DATA_TIMEOUT receive watchdog;
+                # data_skew: fetches of the first K batches each sleep
+                # MXTPU_DATA_SKEW_SECS (persistent input straggler)
                 self.args[site] = int(arg) if arg else 0
                 self.counts[site] = 1
             elif site in ("kill_rank", "slow_rank", "heartbeat_loss",
@@ -364,6 +377,29 @@ def maybe_stall(site="stall_collective"):
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
         time.sleep(0.05)
+
+
+def maybe_data_fault(batch_idx):
+    """Input-pipeline fault sites, keyed by BATCH index, called from the
+    loader worker fetching that batch (thread transport; spawn workers
+    run the stdlib mirror in ``gluon/data/_shm_worker.py``):
+
+    - ``worker_hang:K`` — the fetch of batch K sleeps
+      ``MXTPU_DATA_HANG_SECS`` (default 10, bounded so interpreter
+      teardown can't deadlock on the worker), one-shot.  Far past any
+      sane ``MXTPU_DATA_TIMEOUT``, so the receive watchdog fires first.
+    - ``data_skew:K`` — fetches of batches 0..K-1 each sleep
+      ``MXTPU_DATA_SKEW_SECS`` (default 0.05); persistent, never
+      consumed (straggler-style input skew).
+    """
+    k = fault_arg("worker_hang")
+    if k is not None and int(k) == int(batch_idx) and \
+            consume_fault("worker_hang"):
+        time.sleep(float(os.environ.get("MXTPU_DATA_HANG_SECS", 10.0)))
+        return
+    k = fault_arg("data_skew")
+    if k is not None and int(batch_idx) < int(k):
+        time.sleep(float(os.environ.get("MXTPU_DATA_SKEW_SECS", 0.05)))
 
 
 def maybe_kill_rank(rank, step=None):
@@ -679,6 +715,47 @@ def guard_checkpoint(name="checkpoint"):
 _CKPT_MAGIC = b"MXTCKPT1"
 
 
+#: version of the data-pipeline-state stamp wrapper (the inner state
+#: dict carries its own ``gluon/data/state.py`` version independently)
+_DATA_STATE_STAMP_VERSION = 1
+
+
+def data_state_stamp(sd):
+    """Wrap a data-pipeline ``state_dict`` (gluon/data/state.py) for the
+    checkpoint path: versioned + CRC over the canonical JSON encoding.
+    The stamp rides MANIFEST.json / peer-snapshot frames / the
+    LocalCheckpointer sidecar as an OPTIONAL key — absent on runs that
+    never attached a resumable loader, and old readers ignore it."""
+    payload = json.dumps(sd, sort_keys=True, separators=(",", ":"))
+    return {"version": _DATA_STATE_STAMP_VERSION,
+            "crc": zlib.crc32(payload.encode()) & 0xffffffff,
+            "state": sd}
+
+
+def data_state_unstamp(stamp):
+    """Validate + unwrap a :func:`data_state_stamp`.  Lenient on absence
+    (None in, None out — pre-PR-19 checkpoints restore fine without a
+    data position) but fail-closed on damage: a CRC/version mismatch
+    raises CheckpointCorrupt rather than silently mis-aligning the
+    sample stream."""
+    if stamp is None:
+        return None
+    if not isinstance(stamp, dict) or "state" not in stamp:
+        raise CheckpointCorrupt(
+            f"data-pipeline state stamp malformed: {type(stamp).__name__}")
+    if stamp.get("version") != _DATA_STATE_STAMP_VERSION:
+        raise CheckpointCorrupt(
+            f"data-pipeline state stamp version "
+            f"{stamp.get('version')!r} (this build reads "
+            f"{_DATA_STATE_STAMP_VERSION})")
+    sd = stamp["state"]
+    payload = json.dumps(sd, sort_keys=True, separators=(",", ":"))
+    if zlib.crc32(payload.encode()) & 0xffffffff != stamp.get("crc"):
+        raise CheckpointCorrupt(
+            "data-pipeline state stamp: checksum mismatch")
+    return sd
+
+
 class LocalCheckpointer:
     """Single-host checkpoints with CRC-verified atomic writes.
 
@@ -701,6 +778,10 @@ class LocalCheckpointer:
     def _path(self, step):
         return os.path.join(self._dir, f"ckpt_{int(step):010d}.mxtckpt")
 
+    def _data_path(self, step):
+        return os.path.join(self._dir,
+                            f"ckpt_{int(step):010d}.datastate.json")
+
     @staticmethod
     def _to_host(state):
         """Device arrays pickle as numpy (a restored checkpoint must not
@@ -719,12 +800,22 @@ class LocalCheckpointer:
 
         return conv(state)
 
-    def save(self, step, state):
+    def save(self, step, state, data_state=None):
         payload = pickle.dumps(self._to_host(state), protocol=4)
         header = _CKPT_MAGIC + struct.pack(
             "<IQ", zlib.crc32(payload) & 0xffffffff, len(payload))
         tmp = self._path(step) + ".tmp"
         with guard_checkpoint(f"ckpt_save:{step}"):
+            if data_state is not None:
+                # sidecar FIRST, so the .mxtckpt rename (the commit
+                # point) never exposes a checkpoint whose data position
+                # is still being written
+                dtmp = self._data_path(step) + ".tmp"
+                with open(dtmp, "w") as f:
+                    json.dump(data_state_stamp(data_state), f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(dtmp, self._data_path(step))
             with open(tmp, "wb") as f:
                 f.write(header)
                 f.write(payload)
@@ -748,10 +839,30 @@ class LocalCheckpointer:
     def _prune(self):
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
-            try:
-                os.remove(self._path(s))
-            except OSError:
-                pass
+            for path in (self._path(s), self._data_path(s)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def data_state(self, step=None):
+        """The data-pipeline state saved alongside ``step`` (latest when
+        None), or None when the checkpoint predates resumable loading —
+        lenient on absence, fail-closed (CheckpointCorrupt) on a
+        damaged stamp."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        try:
+            with open(self._data_path(step)) as f:
+                stamp = json.load(f)
+        except FileNotFoundError:
+            return None
+        except ValueError as e:
+            raise CheckpointCorrupt(
+                f"{self._data_path(step)}: unparseable ({e})") from e
+        return data_state_unstamp(stamp)
 
     def restore(self, step=None, template=None):
         if step is None:
@@ -881,10 +992,14 @@ def _log(logger, msg):
         logger.info(msg)
 
 
-def _save_verified(checkpointer, step, state, logger=None):
+def _save_verified(checkpointer, step, state, logger=None,
+                   data_state=None):
     """Save + verify-after-write; one rewrite attempt on a bad readback."""
     for attempt in range(2):
-        checkpointer.save(step, state)
+        if data_state is not None:
+            checkpointer.save(step, state, data_state=data_state)
+        else:
+            checkpointer.save(step, state)
         checkpointer.wait()
         verify = getattr(checkpointer, "verify", None)
         if verify is None:
@@ -903,7 +1018,8 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                   set_state, checkpoint_every=None, max_restarts=3,
                   watchdog_timeout=None, exit_on_preempt=False,
                   recover_on=(RuntimeError, OSError), logger=None,
-                  gang=None, on_reshape=None):
+                  gang=None, on_reshape=None,
+                  get_data_state=None, set_data_state=None):
     """Supervised training loop: auto-resume + preemption checkpointing +
     bounded in-process restarts.
 
@@ -937,6 +1053,13 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
       ``(step, new_checkpointer)`` tuple when the reshape rebuilds the
       checkpoint engine for the new world size); without the callback
       only disk-sourced recoveries (``info.full_state``) can be applied.
+    - ``get_data_state() -> dict`` / ``set_data_state(dict)``: the input
+      pipeline's position (``DataLoader.state_dict`` /
+      ``load_state_dict``, gluon/data/state.py).  Saved alongside every
+      checkpoint (MANIFEST.json stamp or LocalCheckpointer sidecar) and
+      re-adopted leniently at every resume point — including gang
+      reshapes — so the sample stream rewinds in lockstep with the
+      trainer state: zero re-read, zero skipped samples.
 
     Returns a :class:`RunReport`.
     """
@@ -950,13 +1073,33 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
     is_async = bool(getattr(checkpointer, "async_save", False))
 
     def save_at(step):
+        ds = None
+        if get_data_state is not None and \
+                hasattr(checkpointer, "data_state"):
+            ds = get_data_state()
         if is_async:
-            checkpointer.save(step, get_state())
+            if ds is not None:
+                checkpointer.save(step, get_state(), data_state=ds)
+            else:
+                checkpointer.save(step, get_state())
         else:
-            _save_verified(checkpointer, step, get_state(), logger)
+            _save_verified(checkpointer, step, get_state(), logger,
+                           data_state=ds)
+
+    def adopt_data_state(step):
+        """Rewind the input pipeline to the restored step's position —
+        lenient when the checkpoint carries none (pre-data-state
+        manifests, fresh starts)."""
+        if set_data_state is None or not step:
+            return
+        ds_fn = getattr(checkpointer, "data_state", None)
+        ds = ds_fn(step) if ds_fn is not None else None
+        if ds is not None:
+            set_data_state(ds)
 
     report = RunReport()
     step = resume_latest(checkpointer, set_state, logger)
+    adopt_data_state(step)
     report.resumed_from.append(step)
     _tel_event("resume", step=step)
     last_saved = step
@@ -983,6 +1126,7 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                 "shards; pass on_reshape= to merge them into trainer "
                 "state") from rf
         is_async = bool(getattr(checkpointer, "async_save", False))
+        adopt_data_state(step)
         last_saved = step
         step_box[0] = step
         report.resumed_from.append(step)
@@ -1012,6 +1156,7 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                 report.restarts += 1
                 handler.preempted.clear()
                 step = resume_latest(checkpointer, set_state, logger)
+                adopt_data_state(step)
                 report.resumed_from.append(step)
                 _tel_event("restart", step=step, reason="preempted")
                 continue
@@ -1038,6 +1183,7 @@ def run_resilient(step_fn, checkpointer, num_steps, *, get_state,
                              f"{report.restarts}/{max_restarts}")
                 reason = type(e).__name__
                 step = resume_latest(checkpointer, set_state, logger)
+                adopt_data_state(step)
                 report.resumed_from.append(step)
                 _tel_event("restart", step=step, reason=reason)
                 continue

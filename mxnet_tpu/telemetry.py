@@ -62,8 +62,13 @@ _LOCK = threading.Lock()
 # id-prep time of a captured sparse step, microseconds, >= 0) and
 # ``unique_fraction`` (unique ids / total ids, in (0, 1]); v1–v5
 # records stay valid.
-SCHEMA_VERSION = 6
-_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5, 6)
+# v7 (resumable input pipeline): step records may carry
+# ``samples_seen`` (global samples delivered to training so far, a
+# non-negative int), and the event stream gains ``data_resume`` /
+# ``batch_quarantined`` / ``data_worker_timeout`` kinds; v1–v6 records
+# stay valid.
+SCHEMA_VERSION = 7
+_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -1210,4 +1215,10 @@ def validate_record(rec):
     if uf is not None and \
             (not isinstance(uf, (int, float)) or not 0 < uf <= 1):
         fail("unique_fraction must be a number in (0, 1] or absent")
+    # optional input-pipeline field (schema v7): absent when no
+    # resumable pipeline is attached to the trainer
+    ss = rec.get("samples_seen")
+    if ss is not None and \
+            (not isinstance(ss, int) or isinstance(ss, bool) or ss < 0):
+        fail("samples_seen must be a non-negative int or absent")
     return rec

@@ -847,7 +847,7 @@ def test_every_fault_site_is_documented_and_tested():
     and (b) be exercised by at least one test under tests/ — and the
     docs table must not carry stale rows the parser rejects."""
     sites = _parser_sites()
-    assert len(sites) >= 23, sorted(sites)
+    assert len(sites) >= 25, sorted(sites)
 
     docs = open(os.path.join(_REPO, "docs", "env_vars.md")).read()
     assert "### Fault sites" in docs

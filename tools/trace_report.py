@@ -147,6 +147,7 @@ def report_run(run, records, out):
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
+        report_data(kinds, out)
         report_integrity(kinds, attestations, out)
         report_fleet(kinds, requests, out)
         report_autotune(kinds, trials, out)
@@ -371,6 +372,47 @@ def report_resilience(kinds, out):
     for e in kinds.get("inflight_save_dropped", ()):
         out.write(f"    inflight save dropped: step "
                   f"{e.get('step', '?')} ({e.get('reason', '?')})\n")
+
+
+def report_data(kinds, out):
+    """Input-pipeline section (docs/resilience.md "Data-pipeline
+    state"): every exactly-once resume with its sample ledger — the
+    re-read and skipped counts MUST both be 0, anything else is
+    flagged — plus the quarantine census (which poisoned batches the
+    post-rollback replay refused, one ``batch_quarantined`` event
+    each) and hung-worker timeouts.  Prints nothing for runs without
+    a resumable pipeline."""
+    data_kinds = ("data_resume", "batch_quarantined",
+                  "data_worker_timeout")
+    if not any(k in kinds for k in data_kinds):
+        return
+    out.write("  data pipeline:\n")
+    resumes = kinds.get("data_resume", ())
+    if resumes:
+        reread = sum(e.get("reread_samples") or 0 for e in resumes)
+        skipped = sum(e.get("skipped_samples") or 0 for e in resumes)
+        flag = "" if reread == 0 and skipped == 0 else \
+            "  ** NOT exactly-once **"
+        out.write(f"    resumes: {len(resumes)}  re-read samples "
+                  f"{reread}  skipped samples {skipped}{flag}\n")
+        for e in resumes:
+            out.write(f"      epoch {e.get('epoch', '?')} cursor "
+                      f"{e.get('cursor', '?')} (samples_seen "
+                      f"{e.get('samples_seen', '?')}, world "
+                      f"{e.get('world', '?')})\n")
+    quarantined = kinds.get("batch_quarantined", ())
+    if quarantined:
+        ids = [(e.get("epoch", "?"), e.get("batch", "?"))
+               for e in quarantined]
+        samples = sum(e.get("samples") or 0 for e in quarantined)
+        out.write(f"    quarantined batches skipped on replay: "
+                  f"{len(quarantined)} ({samples} sample(s)): "
+                  f"{ids}\n")
+    timeouts = kinds.get("data_worker_timeout", ())
+    if timeouts:
+        batches = [e.get("batch", "?") for e in timeouts]
+        out.write(f"    worker-hang timeouts: {len(timeouts)} "
+                  f"(batches {batches})\n")
 
 
 def report_fleet(kinds, requests, out):
