@@ -1105,6 +1105,188 @@ def bench_fleet(errors):
     return out or None
 
 
+def _partition_gang_thread(res, dist, np, server, rank, world,
+                           num_steps, snap_every, out, *, hb_timeout,
+                           step_s):
+    """One rank of the partition bench (PR 20 fencing): same KV-plane
+    allreduce loop as `_fleet_gang_thread`, plus the GangFenced path —
+    a partitioned rank parks, probes a STALE durable write against the
+    healed KV (must be rejected by the fence), then rejoins via
+    `park_fenced`."""
+    kv = gang = None
+    try:
+        kv = dist.TcpKV(server.addr, rank=rank)
+        gang = res.ElasticGang(rank, world, kv=kv,
+                               peer_snap_every=snap_every,
+                               heartbeat_interval=0.05,
+                               heartbeat_timeout=hb_timeout)
+        gang.start()
+        state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+        step, losses, computed = 0, {}, 0
+        reshape_ms, fenced, rejoined = [], False, False
+        probe_rejected = probe_committed = 0
+        fenced_ms = None
+        rec = {"rank": rank, "gang": gang, "kv": kv, "losses": losses,
+               "reshape_ms": reshape_ms}
+        while step < num_steps:
+            t_try = time.monotonic()
+            try:
+                gang.step_tick(step, state=state)
+                epoch = gang.epoch
+                kv.put_json(f"red/{epoch}/{step}/{rank}",
+                            {"v": (rank + 1) * float(state["w"].sum())})
+                gang.barrier(f"red{step}")
+                total = sum(
+                    float(kv.get_json(f"red/{epoch}/{step}/{r}")["v"])
+                    for r in sorted(gang.members))
+                loss = total / len(gang.members)
+                computed += 1
+            except (res.GangFenced, dist.GangKVError):
+                fenced = True
+                stale_epoch = gang.epoch
+                # wait out the partition with read-only probes, then
+                # attempt ONE stale durable write: the fence must
+                # reject it (FencedWrite) — that rejection IS the
+                # minority_zero_durable_writes evidence
+                t_f = time.monotonic()
+                while time.monotonic() - t_f < 20.0:
+                    try:
+                        kv.get_json("epoch/current")
+                        break
+                    except (dist.GangKVError, OSError):
+                        time.sleep(0.1)
+                try:
+                    kv.put_if_epoch(f"zombie/{rank}", b"stale",
+                                    stale_epoch)
+                    probe_committed += 1
+                except dist.FencedWrite:
+                    probe_rejected += 1
+                except (dist.GangKVError, res.MXNetError, OSError):
+                    pass
+                try:
+                    info = gang.park_fenced(timeout=20.0)
+                except res.MXNetError:
+                    break               # heal/rejoin window missed
+                fenced_ms = (time.monotonic() - t_f) * 1e3
+                rejoined = True
+                if info is not None:
+                    st = info.shards.get(rank) if info.shards else None
+                    if st is None and info.shards:
+                        st = dict(next(iter(info.shards.values())))
+                        st["opt"] = 0.0
+                    if st is not None:
+                        state = {"w": np.array(st["w"],
+                                               dtype=np.float64),
+                                 "opt": float(st["opt"])}
+                    step = info.snap_step
+                continue
+            except res.RankFailure as rf:
+                try:
+                    info = gang.recover(rf)
+                except res.GangEvicted:
+                    gang.stop()
+                    out[rank] = dict(rec, status="evicted",
+                                     computed=computed)
+                    return
+                reshape_ms.append((time.monotonic() - t_try) * 1e3)
+                st = info.shards.get(rank)
+                if st is None:
+                    st = dict(next(iter(info.shards.values())))
+                    st["opt"] = 0.0
+                state = {"w": np.array(st["w"], dtype=np.float64),
+                         "opt": float(st["opt"])}
+                step = info.snap_step
+                continue
+            losses[step] = loss
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss /
+                                                     state["w"].size)
+            state["opt"] += loss
+            step += 1
+            if step_s:
+                time.sleep(step_s)
+        out[rank] = dict(rec, status="done", computed=computed,
+                         fenced=fenced, rejoined=rejoined,
+                         fenced_ms=fenced_ms,
+                         probe_rejected=probe_rejected,
+                         probe_committed=probe_committed,
+                         members=list(gang.members))
+    except Exception as e:              # noqa: BLE001 — surfaced
+        out[rank] = {"rank": rank, "status": "error", "error": repr(e),
+                     "gang": gang, "kv": kv, "losses": {},
+                     "reshape_ms": []}
+
+
+def bench_partition(errors):
+    """Split-brain fencing numbers (PR 20, jax-free thread gang over
+    TcpKV): rank 2 is cut off from the coordinator mid-run
+    (``partition_split:2``), the majority detects it and commits a
+    quorum-gated reshape (``partition_majority_continue_ms`` — compare
+    with ``elastic_recovery_ms``), the minority fences and its stale
+    write probe is REJECTED (gate ``minority_zero_durable_writes``),
+    and after ``MXTPU_PARTITION_SECS`` the partition heals and the
+    fenced rank rejoins (``partition_heal_ms``,
+    ``partition_world_restored``)."""
+    import threading
+    res, dist = _import_elastic()
+    import numpy as np
+
+    server = dist.GangKVServer(lease_ttl=5.0).start()
+    num_steps, snap_every, step_s = 70, 2, 0.06
+    run_out = {}
+    saved = {k: os.environ.get(k)
+             for k in ("MXTPU_FAULT_INJECT", "MXTPU_PARTITION_SECS")}
+    threads = [threading.Thread(
+        target=_partition_gang_thread,
+        args=(res, dist, np, server, r, 3, num_steps, snap_every,
+              run_out),
+        kwargs={"hb_timeout": 0.5, "step_s": step_s},
+        daemon=True) for r in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.8)     # let the gang reach steady state first
+        os.environ["MXTPU_PARTITION_SECS"] = "1.5"
+        os.environ["MXTPU_FAULT_INJECT"] = "partition_split:2"
+        for t in threads:
+            t.join(timeout=90)
+        if any(t.is_alive() for t in threads):
+            errors.append("partition: gang wedged")
+            return None
+        out = {}
+        ms = []
+        for r in (0, 1):
+            v = run_out.get(r)
+            if not v or v.get("status") != "done":
+                errors.append(
+                    f"partition: rank{r} {v and v.get('error')}")
+                return None
+            ms.extend(v["reshape_ms"])
+        v2 = run_out.get(2) or {}
+        if not v2.get("fenced"):
+            errors.append("partition: rank2 never fenced")
+            return None
+        if ms:
+            out["partition_majority_continue_ms"] = \
+                round(sum(ms) / len(ms), 1)
+        out["minority_zero_durable_writes"] = \
+            v2.get("probe_committed", 1) == 0 and \
+            v2.get("probe_rejected", 0) >= 1
+        out["partition_world_restored"] = \
+            v2.get("status") == "done" and v2.get("rejoined") and \
+            sorted(v2.get("members", ())) == [0, 1, 2]
+        if v2.get("fenced_ms") is not None:
+            out["partition_heal_ms"] = round(v2["fenced_ms"], 1)
+        return out
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        res.reset_faults()
+        _fleet_teardown(run_out, server)
+
+
 def _run_worker(env_over, cfg, budget, errors, timed_out=None):
     env = dict(os.environ)
     if env_over is not None:
@@ -1266,6 +1448,11 @@ def orchestrate():
     if headline is not None \
             and not os.environ.get("BENCH_SKIP_FLEET"):
         fleet = bench_fleet(fleet_errors)
+    partition = None
+    partition_errors = []
+    if headline is not None \
+            and not os.environ.get("BENCH_SKIP_PARTITION"):
+        partition = bench_partition(partition_errors)
     if headline is None:
         print(json.dumps({
             "metric": "resnet50_train_samples_per_sec_per_chip",
@@ -1609,6 +1796,18 @@ def orchestrate():
         headline.update(fleet)
     if fleet_errors:
         headline["fleet_error"] = "; ".join(fleet_errors)[-300:]
+    if partition:
+        headline.update(partition)
+        p_ms = headline.get("partition_majority_continue_ms")
+        e_ms = headline.get("elastic_recovery_ms")
+        if p_ms and e_ms:
+            # majority-side continue vs the plain single-death elastic
+            # floor: the quorum gate rides the same detection window,
+            # so the ratio is the price of split-brain safety
+            headline["partition_vs_elastic"] = round(p_ms / e_ms, 3)
+    if partition_errors:
+        headline["partition_error"] = \
+            "; ".join(partition_errors)[-300:]
     _seal_trajectory_point(headline)
     print(json.dumps(headline))
     return 0

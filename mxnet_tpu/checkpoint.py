@@ -320,6 +320,11 @@ class AsyncCheckpointer:
         # dict to the buddy rank every N saves
         self._peer_store = None
         self._peer_every = 0
+        # epoch fencing (attach_gang): stamp the gang epoch into shard
+        # votes and MANIFEST.json, and re-validate it right before the
+        # atomic manifest rename
+        self._gang_epoch_fn = None
+        self._gang_fence_fn = None
 
     # -- paths -----------------------------------------------------------------
 
@@ -426,6 +431,10 @@ class AsyncCheckpointer:
                  "crc": crc, "size": size,
                  "leaves": sorted(mine),
                  "leaf_meta": {str(i): metas[i] for i in metas}}
+        if self._gang_epoch_fn is not None:
+            # the shard vote carries the epoch it was written under —
+            # rank 0's fence check and post-hoc audits read it back
+            entry["gang_epoch"] = int(self._gang_epoch_fn())
         epath = os.path.join(sdir, self._entry_name(self.rank))
         with open(epath + ".tmp", "w") as f:
             json.dump(entry, f)
@@ -476,6 +485,23 @@ class AsyncCheckpointer:
             if every is None else every)
         return self
 
+    def attach_gang(self, epoch_fn, fence_fn=None):
+        """Enable epoch fencing on the durable commit (schema v8).
+
+        ``epoch_fn()`` returns the gang epoch THIS rank believes it is
+        in — stamped into its rank entry and into MANIFEST.json.
+        ``fence_fn()`` returns the highest COMMITTED epoch (the KV
+        fence); rank 0 re-validates its own epoch against it
+        immediately before the atomic manifest rename and ABORTS the
+        commit when a newer epoch has committed meanwhile — a paused
+        or partitioned rank 0 must not publish a stale restore point
+        (``ckpt_fenced`` event, no orphan manifest, the previous
+        manifest stays the restore point).  An unreachable KV fails
+        closed: no fence answer, no rename."""
+        self._gang_epoch_fn = epoch_fn
+        self._gang_fence_fn = fence_fn
+        return self
+
     def _write_manifest(self, step, sdir, skeleton, data_state=None):
         shards, leaf_meta = [], {}
         for r in range(self.world_size):
@@ -515,14 +541,49 @@ class AsyncCheckpointer:
             # version: manifests without it restore exactly as before)
             manifest["data_state"] = resilience.data_state_stamp(
                 data_state)
+        if self._gang_epoch_fn is not None:
+            manifest["gang_epoch"] = int(self._gang_epoch_fn())
         mpath = os.path.join(sdir, "MANIFEST.json")
         with open(mpath + ".tmp", "w") as f:
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        # fence re-validation IMMEDIATELY before the atomic rename: all
+        # the durable work above is harmless (a .tmp is invisible to
+        # restore); the rename is the one operation that publishes a
+        # restore point, so it is the one operation a stale rank 0 —
+        # resumed from a pause, or the minority side of a partition —
+        # must never perform
+        self._check_manifest_fence(step, manifest.get("gang_epoch"),
+                                   mpath)
         os.replace(mpath + ".tmp", mpath)   # THE commit point
         resilience.fsync_dir(sdir)
         resilience.fsync_dir(self._dir)
+
+    def _check_manifest_fence(self, step, epoch, mpath):
+        if self._gang_fence_fn is None or epoch is None:
+            return
+        try:
+            committed = int(self._gang_fence_fn())
+            if committed <= int(epoch):
+                return
+            reason = f"committed gang epoch {committed} > " \
+                     f"this rank's epoch {epoch}"
+        except Exception as e:      # noqa: BLE001 — fail CLOSED: a
+            committed = -1          # rank that cannot read the fence
+            reason = f"gang KV unreachable ({e})"   # must not publish
+        try:
+            os.unlink(mpath + ".tmp")
+        except OSError:
+            pass
+        telemetry.count("ckpt.fenced_aborts")
+        telemetry.event("ckpt_fenced", step=int(step), rank=self.rank,
+                        epoch=int(epoch), committed=committed,
+                        reason=reason[:200])
+        raise MXNetError(
+            f"checkpoint step {step}: manifest commit FENCED — "
+            f"{reason}; the previous manifest remains the restore "
+            f"point")
 
     def _corrupt_shard_fault(self, sdir):
         """``corrupt_shard:K``: bit-rot shard K of the checkpoint that
@@ -867,10 +928,20 @@ class PeerSnapshotStore:
         self.retain_s = float(retain_s)
         self.port = None
         self._held = {}        # from_rank -> {step: (epoch, blob)}
+        self._fence = 0        # drop PUT frames older than this epoch
         self._lock = threading.Lock()
         self._sock = None
         self._thread = None
         self._stop = threading.Event()
+
+    def fence(self, epoch):
+        """Raise the receive fence: PUT frames stamped with a gang
+        epoch older than ``epoch`` are acked but NOT stored — a zombie
+        sender (paused across a reshape, or the minority side of a
+        partition) must not plant stale shards in a live rank's RAM.
+        Monotonic: a lower value never lowers the fence."""
+        with self._lock:
+            self._fence = max(self._fence, int(epoch))
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -935,6 +1006,19 @@ class PeerSnapshotStore:
                 raise CheckpointCorrupt(
                     f"peer snapshot from rank {from_rank} step {step}: "
                     f"checksum mismatch in transit")
+            with self._lock:
+                fence = self._fence
+            if int(epoch) < fence:
+                # stale sender (zombie / partition minority): ack the
+                # frame — the sender is not at fault for trying — but
+                # never store it (schema v8 fencing)
+                telemetry.count("peer_snap.fenced_drops")
+                telemetry.event("fencing_rejected", rank=self.rank,
+                                sender=int(from_rank), epoch=int(epoch),
+                                committed=fence, kind="peer_frame",
+                                step=int(step))
+                conn.sendall(b"OK")
+                return
             self._store(from_rank, step, epoch, blob)
             telemetry.count("peer_snap.recvs")
             conn.sendall(b"OK")

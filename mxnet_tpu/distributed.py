@@ -194,8 +194,12 @@ class FileKV:
     time, including after every member is dead.
     """
 
-    def __init__(self, root):
+    #: highest-committed-epoch fence, shared by every client of the dir
+    _FENCE_KEY = ".epoch_fence"
+
+    def __init__(self, root, rank=None):
         self.root = os.path.abspath(root)
+        self.rank = rank
         os.makedirs(self.root, exist_ok=True)
 
     def _path(self, key):
@@ -203,7 +207,15 @@ class FileKV:
             raise ValueError(f"bad kv key: {key!r}")
         return os.path.join(self.root, *key.split("/"))
 
+    def _check_partition(self):
+        if self.rank is not None and \
+                resilience.partition_blocked(self.rank):
+            raise GangKVError(
+                f"rank {self.rank}: injected partition_split (gang dir "
+                f"unreachable)")
+
     def put(self, key, value):
+        self._check_partition()
         if isinstance(value, str):
             value = value.encode("utf-8")
         path = self._path(key)
@@ -216,14 +228,78 @@ class FileKV:
         os.replace(tmp, path)
 
     def get(self, key, default=None):
+        self._check_partition()
         try:
             with open(self._path(key), "rb") as f:
                 return f.read()
         except (FileNotFoundError, NotADirectoryError):
             return default
 
+    def committed_epoch(self):
+        """The highest epoch any ``put_if_epoch`` committed to this dir."""
+        try:
+            with open(os.path.join(self.root, self._FENCE_KEY)) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def put_if_epoch(self, key, value, epoch):
+        """Fenced write: reject a mutation carrying an epoch OLDER than
+        the highest epoch ever committed through this method (the
+        Chubby-style fencing token).  Equal or newer epochs commit and
+        advance the fence.  Lock-file + recheck: the fence read, the
+        write, and the fence advance happen under an exclusive lock so
+        two writers cannot interleave a stale write past a newer
+        fence.  Raises :class:`FencedWrite` on rejection."""
+        self._check_partition()
+        epoch = int(epoch)
+        lock = os.path.join(self.root, self._FENCE_KEY + ".lock")
+        deadline = time.monotonic() + 5.0
+        fd = None
+        while fd is None:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if time.monotonic() > deadline:
+                    # a crashed lock holder must not wedge the gang:
+                    # break the stale lock and take it
+                    try:
+                        os.unlink(lock)
+                    except FileNotFoundError:
+                        pass
+                else:
+                    time.sleep(0.005)
+        try:
+            fence = self.committed_epoch()
+            if epoch < fence:
+                resilience._tel_event(
+                    "fencing_rejected", rank=self.rank, epoch=epoch,
+                    committed=fence, kind="kv", key=key)
+                raise FencedWrite(
+                    f"kv put {key!r} fenced: epoch {epoch} < committed "
+                    f"epoch {fence}")
+            self.put(key, value)
+            if epoch > fence:
+                fpath = os.path.join(self.root, self._FENCE_KEY)
+                tmp = fpath + f".tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(str(epoch))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, fpath)
+        finally:
+            os.close(fd)
+            try:
+                os.unlink(lock)
+            except FileNotFoundError:
+                pass
+
+    def put_json_if_epoch(self, key, obj, epoch):
+        self.put_if_epoch(key, json.dumps(obj, sort_keys=True), epoch)
+
     def scan(self, prefix):
         """All (key, value) pairs under ``prefix`` (non-recursive)."""
+        self._check_partition()
         base = self._path(prefix)
         try:
             names = sorted(os.listdir(base))
@@ -244,6 +320,7 @@ class FileKV:
         return out
 
     def delete(self, key):
+        self._check_partition()
         try:
             os.remove(self._path(key))
         except FileNotFoundError:
@@ -337,18 +414,30 @@ _KV_HDR = struct.Struct("<BIQ")   # code u8 | crc32 u32 | payload_len u64
 _KV_MAX_FRAME = 64 << 20          # control-plane values are small
 
 (_OP_PUT, _OP_GET, _OP_SCAN, _OP_DEL, _OP_RENEW, _OP_WATCH,
- _OP_STATE, _OP_PING) = range(1, 9)
+ _OP_STATE, _OP_PING, _OP_PUT_IF_EPOCH) = range(1, 10)
 _ST_OK, _ST_ERR = 0, 1
 
 _OP_NAMES = {_OP_PUT: "put", _OP_GET: "get", _OP_SCAN: "scan",
              _OP_DEL: "delete", _OP_RENEW: "renew", _OP_WATCH: "watch",
-             _OP_STATE: "state", _OP_PING: "ping"}
+             _OP_STATE: "state", _OP_PING: "ping",
+             _OP_PUT_IF_EPOCH: "put_if_epoch"}
+
+#: server-side error prefix a fenced mutation comes back with; the
+#: client turns it into :class:`FencedWrite` instead of retrying
+_FENCED_ERR = "fenced:"
 
 
 class GangKVError(resilience.MXNetError):
     """The TCP gang KV could not complete an operation (after retries
-    and failover attempts) — or a `net_partition` fault is armed for
-    this rank."""
+    and failover attempts) — or a `net_partition` / `partition_split`
+    fault is armed for this rank."""
+
+
+class FencedWrite(resilience.MXNetError):
+    """A ``put_if_epoch`` mutation carried an epoch older than the
+    highest committed one: the writer is on the losing side of a
+    reshape (zombie or partition minority) and must not mutate shared
+    state.  Deliberately NOT retryable — the fence only moves forward."""
 
 
 def _recv_exact(conn, n):
@@ -416,9 +505,11 @@ class GangKVServer:
     """
 
     def __init__(self, host="127.0.0.1", port=0, *, lease_ttl=None,
-                 state=None, version=0, leases=None, sock=None):
+                 state=None, version=0, leases=None, sock=None,
+                 fence=0):
         self.lease_ttl = (lease_ttl_from_env() if lease_ttl is None
                           else float(lease_ttl))
+        self._fence = int(fence)    # highest committed gang epoch
         self._data = {}
         for k, v in (state or {}).items():
             self._data[k] = v if isinstance(v, bytes) else \
@@ -562,6 +653,21 @@ class GangKVServer:
                     lease["deadline"] = time.monotonic() + self.lease_ttl
                 self._cond.notify_all()
                 return self._ver
+        if code == _OP_PUT_IF_EPOCH:
+            key, value, epoch = args
+            _check_kv_key(key)
+            epoch = int(epoch)
+            with self._cond:
+                if epoch < self._fence:
+                    raise ValueError(
+                        f"{_FENCED_ERR} put {key!r} epoch {epoch} < "
+                        f"committed epoch {self._fence}")
+                self._data[key] = value
+                self._ver += 1
+                self._key_ver[key] = self._ver
+                self._fence = max(self._fence, epoch)
+                self._cond.notify_all()
+                return self._ver
         if code == _OP_GET:
             with self._cond:
                 return self._data.get(args[0])
@@ -608,7 +714,8 @@ class GangKVServer:
             with self._cond:
                 return (self._ver, dict(self._data),
                         {lid: sorted(l["keys"])
-                         for lid, l in self._leases.items()})
+                         for lid, l in self._leases.items()},
+                        self._fence)
         if code == _OP_PING:
             return self._ver
         raise ValueError(f"gang kv: unknown op {code}")
@@ -662,10 +769,17 @@ class TcpKV:
         self._stagger = float(
             os.environ.get("MXTPU_KV_FAILOVER_STAGGER", 0.5))
         self._retries = int(os.environ.get("MXTPU_KV_RETRIES", 10))
+        # total-elapsed retry budget (s): bounds partition-era retries so
+        # callers fail over to fencing checks instead of spinning forever
+        try:
+            self._max_elapsed = float(
+                os.environ.get("MXTPU_KV_MAX_ELAPSED", "0")) or None
+        except ValueError:
+            self._max_elapsed = None
         self._conn = None
         self._conn_lock = threading.Lock()
         self._state_lock = threading.Lock()
-        self._state = ({}, 0)        # (data, version) — failover seed
+        self._state = ({}, 0, 0)     # (data, version, fence) — failover seed
         self._written = {}           # key -> value LRU (failover replay)
         self._leased = set()
         self._down_since = None
@@ -738,6 +852,11 @@ class TcpKV:
                 self.rank in resilience.fault_args("net_partition"):
             raise GangKVError(
                 f"rank {self.rank}: injected net partition")
+        if self.rank is not None and \
+                resilience.partition_blocked(self.rank):
+            raise GangKVError(
+                f"rank {self.rank}: injected partition_split "
+                f"(coordinator unreachable)")
 
         def attempt():
             return self._rpc(op, args, timeout=timeout)
@@ -749,6 +868,7 @@ class TcpKV:
             return resilience.retry_call(
                 attempt, retries=self._retries, backoff=0.05,
                 max_backoff=0.5, jitter=True,
+                max_elapsed=self._max_elapsed,
                 retryable=(ConnectionError, OSError),
                 on_retry=on_retry,
                 description=f"gang kv {_OP_NAMES.get(op, op)}")
@@ -759,9 +879,11 @@ class TcpKV:
     # -- failover --------------------------------------------------------------
 
     def _refresh_state(self):
-        ver, data, leases = self._rpc(_OP_STATE, ())
+        frame = self._rpc(_OP_STATE, ())
+        ver, data, leases = frame[:3]
+        fence = frame[3] if len(frame) > 3 else 0
         with self._state_lock:
-            self._state = (data, ver)
+            self._state = (data, ver, fence)
         return ver
 
     def _candidates(self):
@@ -812,9 +934,11 @@ class TcpKV:
         if self._standby is None:
             return
         with self._state_lock:
-            data, ver = dict(self._state[0]), self._state[1]
+            data, ver, fence = (dict(self._state[0]), self._state[1],
+                                self._state[2])
         srv = GangKVServer(lease_ttl=self._ttl, state=data,
-                           version=ver + 1, sock=self._standby)
+                           version=ver + 1, sock=self._standby,
+                           fence=fence)
         srv.start()
         self._server = srv
         self._standby = None
@@ -876,6 +1000,44 @@ class TcpKV:
         if len(self._written) > self._REPLAY_KEYS:
             self._written.pop(next(iter(self._written)))
         self._call(_OP_PUT, key, value, lease)
+
+    def put_if_epoch(self, key, value, epoch):
+        """Fenced write (server-side check): rejected with
+        :class:`FencedWrite` when ``epoch`` is older than the highest
+        epoch any client committed.  Fenced keys are deliberately kept
+        OUT of the failover-replay LRU — replaying a stale epoch record
+        after a partition heals is exactly the split-brain vector the
+        fence exists to close."""
+        _check_kv_key(key)
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        try:
+            return self._call(_OP_PUT_IF_EPOCH, key, value, int(epoch))
+        except ValueError as e:
+            if str(e).startswith(_FENCED_ERR):
+                resilience._tel_event(
+                    "fencing_rejected", rank=self.rank,
+                    epoch=int(epoch), kind="kv", key=key)
+                raise FencedWrite(str(e)) from e
+            raise
+
+    def put_json_if_epoch(self, key, obj, epoch):
+        return self.put_if_epoch(key, json.dumps(obj, sort_keys=True),
+                                 epoch)
+
+    def committed_epoch(self):
+        """The coordinator's highest committed gang epoch (the fence).
+
+        The full state frame comes back with the answer, so it also
+        refreshes this client's failover seed — a promotion right
+        after a fence check replays the fence it just read, instead of
+        a frame from the last (possibly seconds-old) lease renewal."""
+        frame = self._call(_OP_STATE)
+        ver, data, _leases = frame[:3]
+        fence = frame[3] if len(frame) > 3 else 0
+        with self._state_lock:
+            self._state = (data, ver, fence)
+        return fence
 
     def get(self, key, default=None):
         _check_kv_key(key)
@@ -986,7 +1148,8 @@ def gang_kv():
         raise resilience.MXNetError(
             "MXTPU_GANG_KV=file needs MXTPU_GANG_DIR")
     if root:
-        return FileKV(root)
+        r = os.environ.get("MXTPU_WORKER_RANK")
+        return FileKV(root, rank=int(r) if r is not None else None)
     client = _coordination_client()
     if client is not None and hasattr(client, "key_value_set"):
         return CoordKV(client)

@@ -131,7 +131,7 @@ class CheckpointCorrupt(MXNetError):
     """A checkpoint failed validation (bad magic/length/checksum)."""
 
 
-def _tel_event(kind, **fields):
+def _tel_event(kind, /, **fields):
     """Structured telemetry event, guarded: this module also loads
     standalone (bench.py orchestrator keeps its driver jax-free), where
     the relative import has no package to resolve against."""
@@ -152,6 +152,24 @@ def _tel_identity(rank=None, world=None):
     telemetry.set_identity(rank=rank, world=world)
 
 
+def _tel_set_epoch(epoch):
+    """Stamp the adopted gang epoch onto telemetry step records
+    (schema v8) — same import guard as _tel_event."""
+    try:
+        from . import telemetry
+    except ImportError:
+        return
+    telemetry.set_gang_epoch(int(epoch))
+
+
+def _gang_kv_errors():
+    """Exception classes that mean 'the gang KV is unreachable from
+    this rank' — the fencing trigger.  Resolved lazily because
+    `distributed` imports this module."""
+    from . import distributed
+    return (distributed.GangKVError, OSError)
+
+
 # -- fault injection -----------------------------------------------------------
 
 class _FaultPlan:
@@ -162,6 +180,8 @@ class _FaultPlan:
         self.counts = {}   # site -> remaining trigger count
         self.args = {}     # site -> numeric arg (step index, seconds, ...)
         self.list_args = {}  # site -> [rank, ...] (repeatable rank sites)
+        self.partition_started = None  # monotonic t of first blocked op
+        self.partition_healed = False  # heal announced (telemetry, once)
         for item in (spec or "").split(","):
             item = item.strip()
             if not item:
@@ -205,17 +225,23 @@ class _FaultPlan:
                 self.args[site] = int(arg) if arg else 0
                 self.counts[site] = 1
             elif site in ("kill_rank", "slow_rank", "heartbeat_loss",
-                          "net_partition"):
+                          "net_partition", "partition_split"):
                 # rank-targeted sites: repeatable ("kill_rank:1,
                 # kill_rank:2"), persistent conditions (no counter) —
                 # each process checks its OWN gang rank against the
                 # list.  net_partition:K cuts rank K's TcpKV client off
                 # from the coordinator (every op raises GangKVError)
-                # while the process keeps running
+                # while the process keeps running.
+                # partition_split:K is the ASYMMETRIC variant: listed
+                # ranks (the minority group) get net_partition-style
+                # timeouts on every gang-KV op while unlisted ranks
+                # keep full connectivity; the cut HEALS after
+                # MXTPU_PARTITION_SECS (measured from the first blocked
+                # op), after which the fenced minority can rejoin
                 self.list_args.setdefault(site, []).append(
                     int(arg) if arg else 0)
             elif site in ("bit_flip_param", "bit_flip_grad",
-                          "bad_core"):
+                          "bad_core", "pause_rank"):
                 # silent-data-corruption sites (integrity.py): rank-
                 # targeted like kill_rank, but ONE-SHOT per listed rank
                 # — bit_flip_param:K flips one bit in rank K's first
@@ -223,7 +249,11 @@ class _FaultPlan:
                 # bit_flip_grad:K flips one bit in a gradient before
                 # the update (eager path only, nan_grad routing);
                 # bad_core:K perturbs rank K's step input so its
-                # compute is deterministically wrong (compute SDC)
+                # compute is deterministically wrong (compute SDC);
+                # pause_rank:K SIGSTOPs rank K's process for
+                # MXTPU_PAUSE_SECS then SIGCONTs it (one-shot) — the
+                # zombie-rank scenario: suspended across a reshape,
+                # resumed after its own eviction
                 r = int(arg) if arg else 0
                 self.list_args.setdefault(site, []).append(r)
                 self.counts[f"{site}:{r}"] = 1
@@ -426,6 +456,55 @@ def maybe_slow_rank(rank):
         time.sleep(float(os.environ.get("MXTPU_SLOW_RANK_SECS", "0.2")))
 
 
+def partition_blocked(rank):
+    """``partition_split:K``: True while rank K's side of the injected
+    asymmetric partition is cut off from the gang KV.  The cut heals
+    ``MXTPU_PARTITION_SECS`` (default 0 = never) after the FIRST blocked
+    op, so one plan expresses the whole partition lifecycle: minority
+    fences, majority reshapes, minority rejoins after the heal.  Checked
+    by the KV transports (``FileKV`` / ``TcpKV``), which raise
+    ``GangKVError`` while blocked."""
+    plan = _plan()
+    if plan is None or rank not in plan.list_args.get(
+            "partition_split", ()):
+        return False
+    now = time.monotonic()
+    with _PLAN_LOCK:
+        if plan.partition_started is None:
+            plan.partition_started = now
+        started = plan.partition_started
+    try:
+        heal_s = float(os.environ.get("MXTPU_PARTITION_SECS", "0"))
+    except ValueError:
+        heal_s = 0.0
+    if heal_s > 0 and now - started >= heal_s:
+        return False
+    return True
+
+
+def maybe_pause_rank(rank):
+    """``pause_rank:K``: SIGSTOP this process when its gang rank is K
+    (one-shot), with a detached helper process sending SIGCONT after
+    ``MXTPU_PAUSE_SECS`` (default 3).  The zombie scenario: by resume
+    time the gang has reshaped this rank out, and its very next KV
+    touch must learn the committed epoch and raise ``GangEvicted``
+    before any durable write."""
+    if not consume_rank_fault("pause_rank", rank):
+        return
+    secs = float(os.environ.get("MXTPU_PAUSE_SECS", "3.0"))
+    sys.stderr.write(f"[resilience] injected pause_rank: SIGSTOP rank "
+                     f"{rank} for {secs}s\n")
+    sys.stderr.flush()
+    import subprocess
+
+    subprocess.Popen(
+        [sys.executable, "-c",
+         f"import os, signal, time; time.sleep({secs}); "
+         f"os.kill({os.getpid()}, signal.SIGCONT)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    os.kill(os.getpid(), signal.SIGSTOP)
+
+
 # -- durable IO ----------------------------------------------------------------
 
 def fsync_dir(path):
@@ -451,12 +530,20 @@ def fsync_dir(path):
 
 # -- retry primitive -----------------------------------------------------------
 
-def retry_call(fn, *, retries=3, deadline=None, backoff=0.1,
-               max_backoff=5.0, jitter=True, retryable=(Exception,),
-               non_retryable=(), on_retry=None, description=None):
+def retry_call(fn, *, retries=3, deadline=None, max_elapsed=None,
+               backoff=0.1, max_backoff=5.0, jitter=True,
+               retryable=(Exception,), non_retryable=(), on_retry=None,
+               description=None):
     """Call ``fn()`` with exponential-backoff-with-jitter retries.
 
     - ``retries``: max retry count (total attempts = retries + 1)
+    - ``max_elapsed``: hard cap on TOTAL elapsed seconds (off by
+      default): once a failed attempt finds the budget spent the
+      original exception is re-raised — unlike ``deadline`` it cannot
+      be overshot by a slow ``fn()`` (e.g. a full connect-timeout per
+      attempt during a network partition), which is what lets
+      partition-era KV retries fail over to fencing checks instead of
+      retrying unboundedly
     - ``deadline``: total wall-clock budget in seconds; a retry whose
       backoff sleep would overshoot the deadline raises instead
     - ``jitter``: on by default — DECORRELATED jitter: each sleep is
@@ -483,6 +570,11 @@ def retry_call(fn, *, retries=3, deadline=None, backoff=0.1,
         except retryable as e:
             if attempt >= retries:
                 raise
+            if max_elapsed is not None and \
+                    time.monotonic() - start >= max_elapsed:
+                raise MXNetError(
+                    f"{what}: retry budget {max_elapsed}s exhausted after "
+                    f"{attempt + 1} attempts: {e}") from e
             if jitter is True:
                 sleep_s = min(max_backoff, _random.uniform(
                     backoff, max(prev_sleep * 3.0, backoff)))
@@ -1243,6 +1335,25 @@ class GangEvicted(MXNetError):
     corrupt the reshaped gang.  Workers treat this as exit code 0."""
 
 
+class GangFenced(MXNetError):
+    """This rank is on the WRONG side of a partition (or cannot reach a
+    quorum of the previous epoch's members): it must not step, must not
+    commit anything durable, and must not propose an epoch.  Unlike
+    `GangEvicted` this is recoverable — the rank keeps heartbeating,
+    parks in `ElasticGang.park_fenced`, and rejoins via `join_req` when
+    the partition heals, adopting the majority's state instead of its
+    own.  Raised by `step_tick`/`recover` when the KV is unreachable or
+    a reshape deadline passes without a strict majority of the previous
+    epoch acking."""
+
+    def __init__(self, reason, epoch=None):
+        self.reason = str(reason)
+        self.epoch = epoch
+        super().__init__(
+            f"gang fenced at epoch {epoch}: {reason}" if epoch is not None
+            else f"gang fenced: {reason}")
+
+
 class HeartbeatPublisher:
     """Per-rank liveness beacon: a daemon thread publishes
     ``hb/<rank> = {rank, seq, step, t}`` to the gang KV every
@@ -1536,6 +1647,18 @@ class ElasticGang:
         self.epoch = 0
         _tel_identity(rank=self.rank, world=len(self.members))
         self.checkpointer = checkpointer
+        # quorum-gated reshape (split-brain safety): an epoch commit
+        # needs acks from a STRICT majority of the previous epoch's
+        # members — dead ranks count against, not for.  MXTPU_QUORUM=0
+        # is the force-new-cluster escape hatch for deliberate
+        # minority-survivor restarts (e.g. 3->1 disk fallback).
+        self._quorum = os.environ.get("MXTPU_QUORUM", "1").lower() \
+            not in ("0", "false", "")
+        self._fenced_at = None
+        if self.checkpointer is not None:
+            attach = getattr(self.checkpointer, "attach_gang", None)
+            if attach is not None:
+                attach(lambda: self.epoch, self._committed_epoch)
         self.peer_snap_every = int(
             os.environ.get("MXTPU_PEER_SNAP_EVERY", 10)
             if peer_snap_every is None else peer_snap_every)
@@ -1572,6 +1695,51 @@ class ElasticGang:
         alive = survivors if survivors is not None else self.members
         return alive and self.rank == min(alive)
 
+    # -- fencing helpers -------------------------------------------------------
+
+    def _committed_epoch(self):
+        """Highest committed epoch: the KV's fence when it keeps one,
+        else the ``epoch/current`` record.  Raises when the KV is
+        unreachable (a partitioned caller must treat that as stale)."""
+        ce = getattr(self.kv, "committed_epoch", None)
+        if ce is not None:
+            return int(ce())
+        cur = self.kv.get_json("epoch/current")
+        return int(cur.get("epoch", 0)) if cur else 0
+
+    def _fence_to(self, epoch):
+        """Propagate the adopted epoch to every durable-write plane:
+        telemetry step records (schema v8 ``gang_epoch``) and the peer
+        snapshot receiver's frame fence."""
+        _tel_set_epoch(epoch)
+        fence = getattr(self.peers, "fence", None)
+        if fence is not None:
+            try:
+                fence(int(epoch))
+            except Exception:       # noqa: BLE001 — best-effort
+                pass
+
+    def _fenced(self, reason):
+        """Build (and announce) the fenced state: the caller raises the
+        returned :class:`GangFenced` and parks in `park_fenced`."""
+        if self._fenced_at is None:
+            self._fenced_at = time.monotonic()
+        _tel_event("gang_fenced", rank=self.rank, epoch=self.epoch,
+                   reason=str(reason)[:200])
+        sys.stderr.write(
+            f"[resilience] rank {self.rank}: FENCED at epoch "
+            f"{self.epoch}: {reason}\n")
+        return GangFenced(reason, epoch=self.epoch)
+
+    def _put_json_fenced(self, key, obj, epoch):
+        """Fenced compare-and-swap write when the KV supports it
+        (`put_json_if_epoch`), plain put otherwise (CoordKV)."""
+        put = getattr(self.kv, "put_json_if_epoch", None)
+        if put is None:
+            self.kv.put_json(key, obj)
+        else:
+            put(key, obj, int(epoch))
+
     # -- lifecycle -------------------------------------------------------------
 
     def start(self):
@@ -1593,6 +1761,7 @@ class ElasticGang:
                 self.kv, self.rank, self.members,
                 timeout=self.detector.timeout)
             self.straggler.detector = self.detector
+        self._fence_to(self.epoch)
         self.hb.start()
         self._started = True
         return self
@@ -1611,40 +1780,50 @@ class ElasticGang:
         Publishes the step id, takes the periodic peer snapshot (from
         ``state`` or lazily from ``state_fn()``), feeds the straggler
         monitor, and raises :class:`RankFailure` on a confirmed peer
-        death / pending join, or :class:`GangEvicted` when a newer epoch
-        excludes this rank.
+        death / pending join, :class:`GangEvicted` when a newer epoch
+        excludes this rank, or :class:`GangFenced` when the gang KV is
+        unreachable (this rank is on the losing side of a partition —
+        park in :meth:`park_fenced`).
         """
         maybe_slow_rank(self.rank)
         maybe_kill_rank(self.rank, step)
+        maybe_pause_rank(self.rank)
         self.hb.note_step(step)
-        if self.peer_snap_every and step % self.peer_snap_every == 0 \
-                and step != self._last_snap_step:
-            if state is None and state_fn is not None:
-                state = state_fn()
-            if state is not None:
-                self.snapshot(step, state)
-        self.straggler.observe(step, collective_share)
-        plan = self._pending_reshape(step)
-        if plan is not None:
-            # planned reshape due NOW: snapshot at this exact step so
-            # the whole gang shares the restore point (zero lost
-            # steps), then reshape with no detection window
-            leavers, admits, at_step = plan
-            if state is None and state_fn is not None:
-                state = state_fn()
-            if state is not None and self._last_snap_step != step:
-                self.snapshot(step, state)
-            raise RankFailure(leavers, self.epoch, joiners=admits,
-                              planned=True, at_step=at_step)
-        self._check_epoch()
-        dead = self.detector.poll() & set(self.members)
-        dead.discard(self.rank)
-        if dead:
-            raise RankFailure(dead, self.epoch)
-        if self._is_proposer():
-            joiners = self._pending_joiners()
-            if joiners:
-                self._schedule_admit(step, joiners)
+        try:
+            # zombie containment: learn the committed epoch FIRST — a
+            # rank resumed after a suspension (SIGSTOP, preemptor
+            # pause) must discover its eviction BEFORE the snapshot's
+            # durable writes below, not after
+            self._check_epoch()
+            if self.peer_snap_every and step % self.peer_snap_every == 0 \
+                    and step != self._last_snap_step:
+                if state is None and state_fn is not None:
+                    state = state_fn()
+                if state is not None:
+                    self.snapshot(step, state)
+            self.straggler.observe(step, collective_share)
+            plan = self._pending_reshape(step)
+            if plan is not None:
+                # planned reshape due NOW: snapshot at this exact step
+                # so the whole gang shares the restore point (zero lost
+                # steps), then reshape with no detection window
+                leavers, admits, at_step = plan
+                if state is None and state_fn is not None:
+                    state = state_fn()
+                if state is not None and self._last_snap_step != step:
+                    self.snapshot(step, state)
+                raise RankFailure(leavers, self.epoch, joiners=admits,
+                                  planned=True, at_step=at_step)
+            dead = self.detector.poll() & set(self.members)
+            dead.discard(self.rank)
+            if dead:
+                raise RankFailure(dead, self.epoch)
+            if self._is_proposer():
+                joiners = self._pending_joiners()
+                if joiners:
+                    self._schedule_admit(step, joiners)
+        except _gang_kv_errors() as e:
+            raise self._fenced(e) from e
 
     def snapshot(self, step, state):
         """RAM-replicate this rank's shard of ``state``: hold our own
@@ -1655,12 +1834,25 @@ class ElasticGang:
         buddy = self.buddy_of(self.rank)
         if buddy != self.rank:
             self.peers.send_to(buddy, step, state, epoch=self.epoch)
-        self.kv.put_json(
-            f"snap/{self.rank}",
-            {"step": int(step),
-             "steps": self.peers.held_steps(self.rank,
-                                            epoch=self.epoch),
-             "epoch": self.epoch})
+        from . import distributed
+        try:
+            self._put_json_fenced(
+                f"snap/{self.rank}",
+                {"step": int(step),
+                 "steps": self.peers.held_steps(self.rank,
+                                                epoch=self.epoch),
+                 "epoch": self.epoch},
+                self.epoch)
+        except distributed.FencedWrite:
+            # a newer epoch committed while this rank was out to lunch
+            # — it is a zombie.  _check_epoch tells the real story
+            # (evicted vs still-member-of-newer-epoch); if the record
+            # is somehow unreadable, evict conservatively.
+            self._check_epoch()
+            raise GangEvicted(
+                f"rank {self.rank}: snapshot write fenced at epoch "
+                f"{self.epoch} (a newer epoch committed while this "
+                f"rank was suspended); exiting cleanly")
         # departed ranks' shards are freed HERE, not in recover():
         # forgetting there races a slower survivor's fetch of the
         # departed rank's shard from this rank's RAM.  Prune only once
@@ -1681,15 +1873,25 @@ class ElasticGang:
 
     def _check_epoch(self):
         cur = self.kv.get_json("epoch/current")
-        if not cur or int(cur.get("epoch", 0)) <= self.epoch:
-            return
-        if self.rank not in cur.get("members", []):
-            raise GangEvicted(
-                f"rank {self.rank}: epoch {cur['epoch']} members "
-                f"{cur.get('members')} exclude this rank (declared "
-                f"dead); exiting cleanly")
-        raise RankFailure(cur.get("dead", []), self.epoch,
-                          joiners=cur.get("joined", []))
+        if cur and int(cur.get("epoch", 0)) > self.epoch:
+            if self.rank not in cur.get("members", []):
+                raise GangEvicted(
+                    f"rank {self.rank}: epoch {cur['epoch']} members "
+                    f"{cur.get('members')} exclude this rank (declared "
+                    f"dead); exiting cleanly")
+            raise RankFailure(cur.get("dead", []), self.epoch,
+                              joiners=cur.get("joined", []))
+        # an epoch still in its ack round (epoch/proposed, uncommitted):
+        # members named by it must enter recover() and ack — the quorum
+        # gate needs their votes.  A rank the proposal EXCLUDES keeps
+        # ticking: its writes carry the old epoch, which stays valid
+        # until the commit advances the fence, and an uncommitted
+        # proposal (it may never reach quorum) must not evict anyone.
+        prop = self.kv.get_json("epoch/proposed")
+        if prop and int(prop.get("epoch", 0)) > self.epoch \
+                and self.rank in prop.get("members", []):
+            raise RankFailure(prop.get("dead", []), self.epoch,
+                              joiners=prop.get("joined", []))
 
     def _pending_joiners(self):
         joiners = []
@@ -1801,7 +2003,15 @@ class ElasticGang:
         state.  Returns a :class:`RecoveryInfo`; the caller re-partitions
         its trainer state from ``info.shards`` (peer source) or
         ``info.full_state`` (disk source) and resumes at
-        ``info.snap_step``."""
+        ``info.snap_step``.  Raises :class:`GangFenced` when the KV
+        becomes unreachable mid-reshape or the proposal cannot gather a
+        strict majority of the previous epoch's acks."""
+        try:
+            return self._recover_inner(failure, checkpointer)
+        except _gang_kv_errors() as e:
+            raise self._fenced(e) from e
+
+    def _recover_inner(self, failure=None, checkpointer=None):
         t0 = time.monotonic()
         ck = checkpointer or self.checkpointer
         dead = set(failure.dead) if failure is not None else set()
@@ -1824,7 +2034,7 @@ class ElasticGang:
         joined = [int(r) for r in proposal.get("joined", [])]
         self.kv.put_json(f"epoch_ack/{epoch}/{self.rank}",
                          {"rank": self.rank, "t": time.time()})
-        self._await_acks(epoch, new_members)
+        self._await_acks(epoch, new_members, old_members, proposal)
         cur = self.kv.get_json("epoch/current") or {}
         if int(cur.get("epoch", -1)) == epoch and \
                 sorted(int(r) for r in cur.get("members", [])) \
@@ -1859,6 +2069,8 @@ class ElasticGang:
         # adopt the new membership
         self.epoch = epoch
         self.members = new_members
+        self._fenced_at = None
+        self._fence_to(epoch)
         _tel_identity(rank=self.rank, world=len(self.members))
         for d in dead:
             self.detector.forget(d)
@@ -1940,6 +2152,39 @@ class ElasticGang:
         self.straggler.detector = self.detector
         return self.recover(None)
 
+    def park_fenced(self, timeout=None, poll=0.25):
+        """Minority-side parking after :class:`GangFenced`: keep
+        heartbeating (the publisher thread already swallows KV errors),
+        do NOT step, do NOT write anything durable — just probe the KV
+        until it is reachable again, then rejoin through the normal
+        ``join_req`` path, adopting the majority's state instead of our
+        own.  Returns `join`'s :class:`RecoveryInfo`, or None when no
+        newer epoch excluded us (we are still a member — resume
+        stepping as-is).  Raises :class:`GangFenced` again if the
+        partition outlives ``timeout`` seconds."""
+        t0 = self._fenced_at if self._fenced_at is not None \
+            else time.monotonic()
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            try:
+                self.kv.get_json("epoch/current")    # read-only probe
+                break
+            except _gang_kv_errors():
+                if deadline is not None and \
+                        time.monotonic() > deadline:
+                    raise self._fenced(
+                        f"partition did not heal within {timeout}s")
+            time.sleep(poll)
+        fenced_ms = (time.monotonic() - t0) * 1000.0
+        self._fenced_at = None
+        _tel_event("partition_healed", rank=self.rank, epoch=self.epoch,
+                   fenced_ms=round(fenced_ms, 2))
+        sys.stderr.write(
+            f"[resilience] rank {self.rank}: partition healed after "
+            f"{fenced_ms:.0f} ms fenced; rejoining\n")
+        return self.join()
+
     # -- protocol internals ----------------------------------------------------
 
     def _await_proposal(self, dead, joiners, ck, target_step=None,
@@ -1951,13 +2196,21 @@ class ElasticGang:
         ``target_step`` the proposal must be able to restore at (every
         member snapshotted there); the target is dropped halfway to the
         reshape timeout so a wedged drain degrades to lost steps rather
-        than a dead gang."""
+        than a dead gang.
+
+        The proposal is STAGED at ``epoch/proposed`` with a plain put —
+        advancing the fence now would reject healthy same-epoch
+        snapshot writes mid-reshape; only the quorum-gated commit in
+        `_await_acks` writes ``epoch/current`` and moves the fence."""
         deadline = time.monotonic() + self.reshape_timeout
         t_half = time.monotonic() + self.reshape_timeout / 2
         while True:
             cur = self.kv.get_json("epoch/current")
             if cur and int(cur.get("epoch", 0)) > self.epoch:
                 return cur
+            prop = self.kv.get_json("epoch/proposed")
+            if prop and int(prop.get("epoch", 0)) > self.epoch:
+                return prop
             dead |= self.detector.poll(force=True) & set(self.members)
             dead.discard(self.rank)
             survivors = sorted(set(self.members) - dead)
@@ -1971,7 +2224,7 @@ class ElasticGang:
                                                target_step=want,
                                                planned=planned)
                 if proposal is not None:
-                    self.kv.put_json("epoch/current", proposal)
+                    self.kv.put_json("epoch/proposed", proposal)
                     return proposal
             if time.monotonic() > deadline:
                 raise MXNetError(
@@ -2039,15 +2292,40 @@ class ElasticGang:
                 "planned": bool(planned),
                 "proposer": self.rank, "t": time.time()}
 
-    def _await_acks(self, epoch, new_members):
+    def _await_acks(self, epoch, new_members, old_members=None,
+                    proposal=None):
+        """The ack round, quorum gate, and fenced commit.
+
+        Every proposed member acks ``epoch_ack/<e>/<r>`` (written by
+        `recover` before this call).  The epoch is COMMITTABLE only
+        once the acks cover a strict majority of the PREVIOUS epoch's
+        members — dead ranks count against, not for, so the minority
+        side of a partition can never commit an epoch, no matter what
+        its detector believes.  The lowest live proposed member then
+        commits ``epoch/current`` with a fenced compare-and-swap
+        (`put_if_epoch`) — which advances the fence and retires the
+        staged ``epoch/proposed`` — and everyone returns once the
+        committed membership has fully acked.  A deadline without
+        quorum raises :class:`GangFenced` (park, rejoin after heal); a
+        deadline with quorum but missing acks keeps the legacy
+        :class:`MXNetError`."""
+        from . import distributed
         deadline = time.monotonic() + self.reshape_timeout
-        want = set(new_members)
+        want = set(int(r) for r in new_members)
+        prev = set(int(r) for r in
+                   (old_members if old_members is not None
+                    else self.members))
+        quorum_of = prev or want
+        quorum_ok = not self._quorum
         while True:
             cur = self.kv.get_json("epoch/current") or {}
-            if int(cur.get("epoch", -1)) == epoch:
+            committed = int(cur.get("epoch", -1)) == epoch
+            rec = cur if committed else \
+                (self.kv.get_json("epoch/proposed") or {})
+            if int(rec.get("epoch", -1)) == epoch:
                 # the record is the source of truth: it may have been
                 # amended below while we waited
-                want = set(int(r) for r in cur.get("members", want))
+                want = set(int(r) for r in rec.get("members", want))
                 if self.rank not in want:
                     raise GangEvicted(
                         f"rank {self.rank}: epoch {epoch} was amended "
@@ -2058,7 +2336,9 @@ class ElasticGang:
                     acked.add(int(key.rsplit("/", 1)[1]))
                 except ValueError:
                     pass
-            if want <= acked:
+            if not quorum_ok:
+                quorum_ok = 2 * len(acked & quorum_of) > len(quorum_of)
+            if committed and want <= acked:
                 return
             # a proposed member that dies BETWEEN the proposal and its
             # ack would wedge this epoch forever (nobody re-detects it
@@ -2066,22 +2346,53 @@ class ElasticGang:
             # member amends the SAME epoch in place, shrinking the
             # membership to the ranks that can still ack; shard
             # assembly re-reads the amended record and falls back to
-            # disk if the second death cost it a RAM holder.
+            # disk if the second death cost it a RAM holder.  The
+            # amendment is a fenced CAS: a zombie amender carrying a
+            # stale epoch is rejected server-side instead of clobbering
+            # the committed record (the resilience.py:2066 race).
             newly_dead = (want - acked) & self.detector.poll(force=True)
             newly_dead.discard(self.rank)
             live = sorted(want - newly_dead)
-            if newly_dead and live and self.rank == min(live) \
-                    and int(cur.get("epoch", -1)) == epoch:
-                cur["members"] = live
-                cur["dead"] = sorted(
-                    set(int(d) for d in cur.get("dead", []))
+            amender = bool(newly_dead) and live and self.rank == min(live)
+            if amender and int(rec.get("epoch", -1)) == epoch:
+                rec["members"] = live
+                rec["dead"] = sorted(
+                    set(int(d) for d in rec.get("dead", []))
                     | newly_dead)
-                cur["joined"] = [j for j in cur.get("joined", [])
+                rec["joined"] = [j for j in rec.get("joined", [])
                                  if int(j) not in newly_dead]
-                cur["t"] = time.time()
-                self.kv.put_json("epoch/current", cur)
+                rec["t"] = time.time()
+                try:
+                    self._put_json_fenced(
+                        "epoch/current" if committed else
+                        "epoch/proposed", rec,
+                        epoch if committed else self.epoch)
+                except distributed.FencedWrite:
+                    pass    # the fence moved under us: re-read above
                 continue
+            if not committed and quorum_ok and live \
+                    and self.rank == min(live) and self.rank in acked:
+                # quorum reached: commit.  put_if_epoch(epoch) advances
+                # the fence, so every stale writer (minority proposer,
+                # resumed zombie) is rejected from here on.
+                commit = dict(rec) if int(rec.get("epoch", -1)) == epoch \
+                    else dict(proposal or {})
+                if int(commit.get("epoch", -1)) == epoch:
+                    try:
+                        self._put_json_fenced("epoch/current", commit,
+                                              epoch)
+                        self.kv.delete("epoch/proposed")
+                    except distributed.FencedWrite:
+                        pass    # a newer epoch beat us; re-read above
+                    continue
             if time.monotonic() > deadline:
+                if not committed and self._quorum and not quorum_ok:
+                    raise self._fenced(
+                        f"epoch {epoch} proposal gathered only "
+                        f"{sorted(acked & quorum_of)} of previous "
+                        f"members {sorted(quorum_of)} — no strict "
+                        f"majority, refusing to commit (split-brain "
+                        f"guard; MXTPU_QUORUM=0 overrides)")
                 raise MXNetError(
                     f"epoch {epoch}: missing acks from "
                     f"{sorted(want - acked)} after "

@@ -50,6 +50,7 @@ class ReplicaServer:
                          if poll_ms is None else poll_ms)
         self.loaded_step = None
         self._fetched_step = None    # newest step the poller restored
+        self._served_epoch = None    # gang_epoch of the staged manifest
         self._staged = None          # (step, state) awaiting swap
         self._staged_lock = threading.Lock()
         self._stop = threading.Event()
@@ -125,6 +126,22 @@ class ReplicaServer:
                             step=int(step),
                             reason=f"provenance: {why}"[:200])
             return False
+        # epoch fence (schema v8): never serve a manifest from a gang
+        # epoch OLDER than the one already served — a fenced trainer's
+        # stale commit (partition minority, resumed zombie) must not
+        # roll the serving weights backwards.  Manifests without the
+        # stamp (pre-v8, or gang-less trainers) pass unchanged.
+        epoch = m.get("gang_epoch")
+        if epoch is not None and self._served_epoch is not None \
+                and int(epoch) < self._served_epoch:
+            telemetry.event("serving_reload_rejected", rank=self.rank,
+                            step=int(step),
+                            reason=f"stale_epoch: manifest gang_epoch "
+                                   f"{int(epoch)} < served "
+                                   f"{self._served_epoch}"[:200])
+            return False
+        if epoch is not None:
+            self._served_epoch = int(epoch)
         return True
 
     def _poll_loop(self):

@@ -67,8 +67,13 @@ _LOCK = threading.Lock()
 # non-negative int), and the event stream gains ``data_resume`` /
 # ``batch_quarantined`` / ``data_worker_timeout`` kinds; v1–v6 records
 # stay valid.
-SCHEMA_VERSION = 7
-_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+# v8 (split-brain fencing): step records may carry ``gang_epoch`` (the
+# committed elastic-gang epoch the step ran under, a non-negative
+# int), and the event stream gains ``fencing_rejected`` /
+# ``ckpt_fenced`` / ``gang_fenced`` / ``partition_healed`` kinds;
+# v1–v7 records stay valid.
+SCHEMA_VERSION = 8
+_ACCEPTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # autotune trial marking (mxnet_tpu/autotune/runner.py): while a trial
 # config is being timed every step record is stamped
@@ -96,6 +101,19 @@ def set_config_fingerprint(config_fingerprint):
     global _CONFIG_FP
     _CONFIG_FP = None if config_fingerprint is None \
         else str(config_fingerprint)
+
+
+# the committed elastic-gang epoch this process last adopted (schema
+# v8); stamped onto step records so a post-hoc reader can tell which
+# membership a step ran under — the forensic trail for fencing audits.
+_GANG_EPOCH = None
+
+
+def set_gang_epoch(epoch):
+    """Stamp subsequent step records with the adopted gang epoch
+    (schema v8 ``gang_epoch``); None clears."""
+    global _GANG_EPOCH
+    _GANG_EPOCH = None if epoch is None else int(epoch)
 
 #: bf16 peak FLOP/s per chip by device-kind substring (public specs).
 #: The ``cpu`` entry is a NOMINAL host figure so ratio gating works on
@@ -596,12 +614,14 @@ def reset(close_sink=True):
     sink handle — test isolation, not a runtime API."""
     global _SINK, _SINK_SIZE, _LAST_END, _LAST_COUNTS, _CURRENT
     global _PEAK_CACHE, _TRIAL_FP, _CONFIG_FP, _IDENT, _TAIL_BYTES
+    global _GANG_EPOCH
     with _LOCK:
         _RECENT.clear()
         _EVENT_COUNTS.clear()
     _CURRENT = None
     _TRIAL_FP = None
     _CONFIG_FP = None
+    _GANG_EPOCH = None
     _LAST_END = None
     _LAST_COUNTS = {}
     _PEAK_CACHE = None
@@ -840,6 +860,8 @@ def step_end(acc, step=None, skipped=False):
         rec["config_fingerprint"] = _TRIAL_FP
     elif _CONFIG_FP is not None:
         rec["config_fingerprint"] = _CONFIG_FP
+    if _GANG_EPOCH is not None:
+        rec["gang_epoch"] = _GANG_EPOCH
     for k, v in acc.fields.items():
         rec[k] = v
     _emit(rec)
@@ -1221,4 +1243,10 @@ def validate_record(rec):
     if ss is not None and \
             (not isinstance(ss, int) or isinstance(ss, bool) or ss < 0):
         fail("samples_seen must be a non-negative int or absent")
+    # optional gang-fencing field (schema v8): absent outside an
+    # elastic gang
+    ge = rec.get("gang_epoch")
+    if ge is not None and \
+            (not isinstance(ge, int) or isinstance(ge, bool) or ge < 0):
+        fail("gang_epoch must be a non-negative int or absent")
     return rec

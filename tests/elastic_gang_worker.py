@@ -18,9 +18,11 @@ Protocol lines on stdout (flushed, parsed by the test):
 
     PID <rank> <pid>
     LOSS <rank> <epoch> <step> <loss-as-float-hex>
+    FENCED <rank> <epoch>
     EVICTED <rank>
     RESULT <json>   (rank, pid, final_step, w0 hex, epoch, members,
-                     source, disk_restores, reshapes)
+                     source, disk_restores, reshapes, fenced,
+                     rejoined, evictions)
 
 Usage:  elastic_gang_worker.py <work_dir> <num_steps> [snap_every]
                                [step_ms]
@@ -28,6 +30,13 @@ Env:    MXTPU_WORKER_RANK, MXTPU_NUM_WORKERS, and a control plane —
         MXTPU_GANG_DIR (FileKV) or MXTPU_GANG_KV=tcp + MXTPU_GANG_ADDR
         (TcpKV, no shared filesystem) — plus the resilience knobs the
         test sets: heartbeat interval/timeout, MXTPU_KILL_AT_STEP, ...
+
+Split-brain extras: MXTPU_FAULT_AT_STEP defers MXTPU_FAULT_INJECT's
+arming until this rank reaches that step (partition_split/pause_rank
+must not fire while the gang is still forming).  On a KV cut the
+worker parks fenced and rejoins after the heal; with
+MXTPU_REJOIN_ON_EVICT=1 an evicted rank (the resumed-zombie case)
+re-enters via gang.join() instead of exiting.
 """
 
 import importlib
@@ -110,6 +119,16 @@ def main():
             with open(marker, "w") as f:
                 f.write("armed")
 
+    # deferred arming: partition_split / pause_rank fired at spawn time
+    # would cut the rank off before the gang even forms — hold the plan
+    # until this rank's own step counter reaches MXTPU_FAULT_AT_STEP
+    fault_at = os.environ.get("MXTPU_FAULT_AT_STEP")
+    deferred_fault = None
+    if fault_at is not None:
+        fault_at = int(fault_at)
+        deferred_fault = os.environ.pop("MXTPU_FAULT_INJECT", None)
+        res.reset_faults()
+
     _emit(f"PID {rank} {os.getpid()}")
 
     kv = dist.gang_kv()     # FileKV (MXTPU_GANG_DIR) or TcpKV
@@ -119,31 +138,62 @@ def main():
                            peer_snap_every=snap_every)
     state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
     step = 0
-    stats = {"reshapes": 0, "disk_restores": 0, "source": None}
+    stats = {"reshapes": 0, "disk_restores": 0, "source": None,
+             "fenced": 0, "rejoined": 0, "evictions": 0}
+    rejoin_on_evict = os.environ.get(
+        "MXTPU_REJOIN_ON_EVICT", "") not in ("", "0")
+
+    def adopt_info(info):
+        nonlocal state, step
+        state = _adopt(np, info, rank)
+        step = info.snap_step
+        stats["reshapes"] += 1
+        stats["source"] = info.source
+        if info.source == "disk":
+            stats["disk_restores"] += 1
 
     try:
         info = gang.join()
         if info is not None:
-            state = _adopt(np, info, rank)
-            step = info.snap_step
-            stats["reshapes"] += 1
-            stats["source"] = info.source
+            adopt_info(info)
         while step < num_steps:
+            if deferred_fault is not None and step >= fault_at:
+                os.environ["MXTPU_FAULT_INJECT"] = deferred_fault
+                res.reset_faults()
+                deferred_fault = None
             try:
-                gang.step_tick(step, state=state)
-                if step % snap_every == 0:
-                    ck.save(step, state)
-                w = state["w"]
-                loss = _allreduce(gang, kv, step,
-                                  (rank + 1) * float(w.sum()))
-            except res.RankFailure as rf:
-                info = gang.recover(rf)
-                state = _adopt(np, info, rank)
-                step = info.snap_step
-                stats["reshapes"] += 1
-                stats["source"] = info.source
-                if info.source == "disk":
-                    stats["disk_restores"] += 1
+                try:
+                    gang.step_tick(step, state=state)
+                    if step % snap_every == 0:
+                        ck.save(step, state)
+                    w = state["w"]
+                    loss = _allreduce(gang, kv, step,
+                                      (rank + 1) * float(w.sum()))
+                except res.RankFailure as rf:
+                    info = gang.recover(rf)
+                    adopt_info(info)
+                    continue
+                except (res.GangFenced, dist.GangKVError):
+                    # the losing side of a partition: no stepping, no
+                    # durable writes — park until the heal, then rejoin
+                    stats["fenced"] += 1
+                    _emit(f"FENCED {rank} {gang.epoch}")
+                    info = gang.park_fenced(timeout=60.0)
+                    stats["rejoined"] += 1
+                    if info is not None:
+                        adopt_info(info)
+                    continue
+            except res.GangEvicted:
+                # declared dead while out to lunch (resumed zombie):
+                # containment already blocked the durable writes; ask
+                # the majority for a planned re-admission
+                if not rejoin_on_evict:
+                    raise
+                stats["evictions"] += 1
+                _emit(f"EVICTED {rank}")
+                info = gang.join()
+                if info is not None:
+                    adopt_info(info)
                 continue
             _emit(f"LOSS {rank} {gang.epoch} {step} {loss.hex()}")
             state["w"] = state["w"] * 0.99 - 0.01 * (loss / w.size)
@@ -160,7 +210,9 @@ def main():
          "w0": float(state["w"][0]).hex(), "epoch": gang.epoch,
          "members": gang.members, "source": stats["source"],
          "disk_restores": stats["disk_restores"],
-         "reshapes": stats["reshapes"],
+         "reshapes": stats["reshapes"], "fenced": stats["fenced"],
+         "rejoined": stats["rejoined"],
+         "evictions": stats["evictions"],
          "kv_failovers": getattr(kv, "failovers", 0)}))
     return 0
 

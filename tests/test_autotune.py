@@ -264,7 +264,7 @@ def test_telemetry_trial_marking_and_v2_schema():
     assert "tuning_trial" not in every[0]
     assert every[2]["config_fingerprint"] == "feedc0ffee12"
     for rec in every:
-        assert rec["v"] == telemetry.SCHEMA_VERSION == 7   # v7: data state
+        assert rec["v"] == telemetry.SCHEMA_VERSION == 8   # v8: fencing
         telemetry.validate_record(rec)
     v1 = dict(every[0])
     v1["v"] = 1                                  # v1 records stay valid

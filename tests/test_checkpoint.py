@@ -813,3 +813,87 @@ def test_two_process_crash_consistency(tmp_path, site):
     final = ck.restore(num_steps)
     assert np.array_equal(final["w"], expected["w"])
     assert np.array_equal(final["b"], expected["b"])
+
+
+# -- epoch fencing on the durable commit (split-brain guard) -------------------
+
+def test_manifest_carries_gang_epoch(tmp_path):
+    """attach_gang stamps the gang epoch into every rank entry and into
+    MANIFEST.json; manifests restore normally and verify() hands the
+    stamp back (the serving reload gate reads it)."""
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    assert ck.attach_gang(lambda: 7, lambda: 7) is ck
+    ck.save(3, _state())
+    ck.wait()
+    with open(os.path.join(ck._step_dir(3), "MANIFEST.json")) as f:
+        assert json.load(f)["gang_epoch"] == 7
+    assert ck.verify(3)["gang_epoch"] == 7
+    _assert_state_equal(ck.restore(3), _state())
+
+
+def test_stale_epoch_manifest_commit_aborted(tmp_path, monkeypatch):
+    """The tentpole abort path: the fence moved on while this rank was
+    out to lunch (paused rank 0, partition minority).  The manifest
+    rename must NOT happen — MXNetError, one ckpt_fenced event, no
+    orphan .tmp, and the PREVIOUS manifest stays the restore point."""
+    from mxnet_tpu import telemetry
+
+    ev_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
+    telemetry.reset()
+    try:
+        ckdir = tmp_path / "ckpt"
+        ck = AsyncCheckpointer(ckdir, async_save=False, rank=0,
+                               world_size=1)
+        ck.attach_gang(lambda: 1, lambda: 1)
+        ck.save(1, _state())
+        ck.wait()
+        assert checkpoint.latest_manifest_step(ckdir) == 1
+        # a quorum elsewhere committed epoch 3: we are now a zombie
+        ck.attach_gang(lambda: 1, lambda: 3)
+        with pytest.raises(resilience.MXNetError, match="FENCED"):
+            ck.save(2, _state())
+        # the previous manifest remains the restore point
+        assert checkpoint.latest_manifest_step(ckdir) == 1
+        _assert_state_equal(ck.restore(), _state())
+        # no half-published manifest anywhere
+        orphans = [f for root, _dirs, files in os.walk(ckdir)
+                   for f in files if f.endswith(".tmp")]
+        assert orphans == []
+    finally:
+        telemetry.reset()
+    with open(ev_path) as f:
+        ev = [json.loads(ln) for ln in f if ln.strip()]
+    fenced = [e for e in ev if e.get("event") == "ckpt_fenced"]
+    assert len(fenced) == 1
+    assert fenced[0]["step"] == 2
+    assert fenced[0]["epoch"] == 1
+    assert fenced[0]["committed"] == 3
+
+
+def test_manifest_commit_fails_closed_on_unreachable_fence(tmp_path):
+    """No fence answer -> no rename: a rank that cannot read the fence
+    might BE the fenced minority, so the commit aborts rather than
+    gambling on a stale restore point."""
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+
+    def down():
+        raise OSError("gang kv unreachable")
+
+    ck.attach_gang(lambda: 1, down)
+    with pytest.raises(resilience.MXNetError, match="FENCED"):
+        ck.save(1, _state())
+    assert checkpoint.latest_manifest_step(tmp_path) is None
+
+
+def test_unfenced_checkpointer_unchanged(tmp_path):
+    """No attach_gang -> no stamp, no fence check: the pre-v8 surface
+    is bitwise what it was."""
+    ck = AsyncCheckpointer(tmp_path, async_save=False, rank=0,
+                           world_size=1)
+    ck.save(1, _state())
+    ck.wait()
+    with open(os.path.join(ck._step_dir(1), "MANIFEST.json")) as f:
+        assert "gang_epoch" not in json.load(f)

@@ -95,7 +95,7 @@ def kv_backend(request, tmp_path):
         kvdir = str(tmp_path / "kv")
 
         def make(rank=None):
-            return distributed.FileKV(kvdir)
+            return distributed.FileKV(kvdir, rank=rank)
 
         yield request.param, make
     else:
@@ -136,6 +136,71 @@ def test_kv_roundtrip(kv_backend):
     v = 1.0 / 3.0 * 7.3
     kv.put_json("red/0/0/0", {"v": v})
     assert kv.get_json("red/0/0/0")["v"] == v
+
+
+def test_kv_put_if_epoch_fencing(kv_backend):
+    """The epoch fence (both planes): an epoch-stamped write at or
+    above the highest committed epoch lands and advances the fence; a
+    STALE one is rejected with FencedWrite and the stored value is
+    untouched.  The fence is server-side state, visible to every
+    client."""
+    _, make = kv_backend
+    kv = make(rank=0)
+    assert kv.committed_epoch() == 0
+    kv.put_if_epoch("a", b"one", 1)         # advances the fence
+    assert kv.get("a") == b"one"
+    assert kv.committed_epoch() == 1
+    kv.put_if_epoch("a", b"two", 1)         # equal epoch: accepted
+    kv.put_if_epoch("a", b"three", 3)       # newer: accepted + advances
+    assert kv.committed_epoch() == 3
+    with pytest.raises(distributed.FencedWrite):
+        kv.put_if_epoch("a", b"stale", 2)
+    assert kv.get("a") == b"three"          # rejected write left no trace
+    kv.put("plain", b"ok")                  # un-stamped writes unaffected
+    assert kv.get("plain") == b"ok"
+    # a SECOND client sees the same fence — this is what stops a
+    # resumed zombie that still believes in the old epoch
+    kv2 = make(rank=1)
+    assert kv2.committed_epoch() == 3
+    with pytest.raises(distributed.FencedWrite):
+        kv2.put_json_if_epoch("a", {"v": 1}, 0)
+    assert kv2.get("a") == b"three"
+
+
+@pytest.mark.faults
+def test_tcpkv_fence_survives_coordinator_failover(fault_inject,
+                                                   monkeypatch):
+    """The fence is part of the coordinator's replicated state frame:
+    after the daemon dies and a standby promotes itself, a stale-epoch
+    write must STILL be rejected — a failover that forgot the fence
+    would reopen the split-brain window at the worst possible
+    moment."""
+    monkeypatch.setenv("MXTPU_KV_FAILOVER_STAGGER", "0.1")
+    server = distributed.GangKVServer(lease_ttl=2.0).start()
+    c0 = c1 = None
+    try:
+        c0 = distributed.TcpKV(server.addr, rank=0, lease_ttl=2.0)
+        c1 = distributed.TcpKV(server.addr, rank=1, lease_ttl=2.0)
+        c0.put_if_epoch("epoch/marker", b"e3", 3)
+        # committed_epoch doubles as a state-frame refresh: the fence
+        # it reads is the fence a promotion will replay
+        assert c0.committed_epoch() == 3
+        assert c1.committed_epoch() == 3
+        time.sleep(0.8)                 # a renewal refreshes the
+        fault_inject("kill_coordinator")  # clients' state frames
+        c0.put_json("arm", {"v": 0})    # mutation -> daemon dies mid-op
+        assert server.died
+        assert c0.failovers == 1
+        # the promoted coordinator still enforces the fence
+        assert c1.committed_epoch() == 3
+        with pytest.raises(distributed.FencedWrite):
+            c1.put_if_epoch("epoch/marker", b"stale", 2)
+        assert c1.get("epoch/marker") == b"e3"
+    finally:
+        for c in (c1, c0):
+            if c is not None:
+                c.close()
+        server.stop()
 
 
 def test_failure_detector_confirms_silence(kv_backend):
@@ -224,6 +289,30 @@ def test_peer_snapshot_retention_and_epoch_filter(tmp_path):
     s2._store(0, 10, 1, b"x")
     assert s2.held_steps(0, epoch=1) == [10]
     assert kv.get_json("held/2/0") == {"steps": [10], "epoch": 1}
+
+
+def test_peer_snapshot_fence_drops_stale_frames(tmp_path):
+    """A receiver whose gang committed a newer epoch must DROP frames
+    stamped with an older one — a fenced trainer's RAM replica must
+    never survive as a restore point — while still ACKING the sender
+    (containment, not a wedge: the zombie learns its fate from the
+    epoch check, not from a hung socket)."""
+    kv = distributed.FileKV(str(tmp_path))
+    s0 = PeerSnapshotStore(0, kv=kv).start()
+    s1 = PeerSnapshotStore(1, kv=kv).start()
+    try:
+        state = {"w": np.arange(4.0)}
+        s1.fence(2)
+        assert s0.send_to(1, 4, state, epoch=1)   # acked ...
+        assert s1.held_steps(0, epoch=1) == []    # ... but NOT stored
+        assert s0.send_to(1, 6, state, epoch=2)   # current epoch lands
+        assert s1.held_steps(0, epoch=2) == [6]
+        s1.fence(1)                               # the fence never moves
+        assert s0.send_to(1, 8, state, epoch=1)   # backwards
+        assert s1.held_steps(0, epoch=1) == []
+    finally:
+        s0.close()
+        s1.close()
 
 
 def test_buddy_ring(tmp_path):
@@ -663,6 +752,203 @@ def test_thread_gang_scheduled_admit_zero_lost_steps(kv_backend):
             res["gang"].stop()
 
 
+# -- split-brain: partition fencing + zombie containment -----------------------
+
+def _run_partition_rank(rank, world, kv_make, num_steps, snap_every, out,
+                        *, step_s=0.05):
+    """Thread rank for the partition matrix.  On a KV cut (GangKVError
+    mid-allreduce, or GangFenced out of step_tick/recover) the rank
+    waits for the heal, probes the fence with a STALE-epoch write —
+    which must be REJECTED: the zero-durable-writes pin — and rejoins
+    via park_fenced."""
+    kv = kv_make(rank)
+    gang = resilience.ElasticGang(rank, world, kv=kv,
+                                  peer_snap_every=snap_every,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=0.5)
+    gang.start()
+    state = {"w": np.full(8, 1.0, dtype=np.float64), "opt": 0.0}
+    step, losses, infos = 0, {}, []
+    fenced = rejoined = False
+    probe_rejected = probe_committed = 0
+
+    def adopt(info):
+        st = info.shards.get(rank)
+        if st is None:                  # readmitted: any replica's w
+            st = dict(next(iter(info.shards.values())))
+            st["opt"] = 0.0
+        return {"w": np.array(st["w"], dtype=np.float64),
+                "opt": float(st["opt"])}
+
+    try:
+        while step < num_steps:
+            try:
+                gang.step_tick(step, state=state)
+                loss = _kv_allreduce(
+                    gang, kv, step,
+                    (rank + 1) * float(state["w"].sum()))
+            except (resilience.GangFenced, distributed.GangKVError):
+                fenced = True
+                stale = gang.epoch
+                # wait until the cut heals AND the majority has
+                # committed the next epoch — the fence the stale probe
+                # below must bounce off
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 20:
+                    try:
+                        cur = kv.get_json("epoch/current")
+                        if cur and int(cur.get("epoch", 0)) > stale:
+                            break
+                    except Exception:   # noqa: BLE001 — still cut
+                        pass
+                    time.sleep(0.05)
+                try:
+                    kv.put_if_epoch(f"zombie/{rank}", b"stale", stale)
+                    probe_committed += 1
+                except distributed.FencedWrite:
+                    probe_rejected += 1
+                info = gang.park_fenced(timeout=30.0)
+                rejoined = True
+                if info is not None:
+                    state = adopt(info)
+                    step = info.snap_step
+                    infos.append(info)
+                continue
+            except resilience.RankFailure as rf:
+                info = gang.recover(rf)
+                state = adopt(info)
+                step = info.snap_step
+                infos.append(info)
+                continue
+            losses[step] = loss
+            state["w"] = state["w"] * 0.99 - 0.01 * (loss /
+                                                     state["w"].size)
+            state["opt"] += loss
+            if step_s:
+                time.sleep(step_s)
+            step += 1
+        out[rank] = {"status": "done", "losses": losses, "gang": gang,
+                     "infos": infos, "w": state["w"], "fenced": fenced,
+                     "rejoined": rejoined,
+                     "probe_rejected": probe_rejected,
+                     "probe_committed": probe_committed}
+    except Exception as e:                  # noqa: BLE001 — surfaced
+        out[rank] = {"status": "error", "error": repr(e), "gang": gang}
+
+
+@pytest.mark.faults
+def test_thread_gang_partition_minority_fences_and_rejoins(
+        kv_backend, fault_inject, tmp_path, monkeypatch):
+    """The split-brain tentpole, end to end, over BOTH control planes:
+    rank 2's side of an asymmetric partition is cut mid-run.  The
+    majority (a strict quorum of the old epoch) commits the next epoch
+    and continues BITWISE; the minority parks fenced with ZERO durable
+    writes — its stale-epoch probe bounces off the fence — then
+    rejoins after the heal and the world is restored to [0, 1, 2].
+    The event log flows through the trace_report fencing section."""
+    _, kv_make = kv_backend
+    ev_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
+    monkeypatch.setenv("MXTPU_PARTITION_SECS", "1.5")
+    telemetry.reset()
+    num_steps, snap_every = 70, 2
+    out = {}
+    threads = [threading.Thread(
+        target=_run_partition_rank,
+        args=(r, 3, kv_make, num_steps, snap_every, out))
+        for r in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)                     # gang forms, snapshots exist
+    fault_inject("partition_split:2")
+    for t in threads:
+        t.join(timeout=90)
+    try:
+        assert not any(t.is_alive() for t in threads), "gang wedged"
+        for r in range(3):
+            assert out.get(r, {}).get("status") == "done", out.get(r)
+        # the minority: fenced, rejected, back in
+        assert out[2]["fenced"], out[2]
+        assert out[2]["rejoined"], out[2]
+        assert out[2]["probe_committed"] == 0, \
+            "a fenced rank's stale write LANDED — split-brain"
+        assert out[2]["probe_rejected"] >= 1, out[2]
+        # world restored after the heal
+        for r in range(3):
+            assert sorted(out[r]["gang"].members) == [0, 1, 2], out[r]
+        # the majority continued BITWISE: replay the membership history
+        # rank 0 actually lived (cut -> [0,1], readmit -> [0,1,2])
+        # against the serial simulation
+        infos0 = out[0]["infos"]
+        assert len(infos0) >= 2, infos0
+        assert infos0[0].members == [0, 1]
+        phases = [(0, [0, 1, 2])]
+        for info in infos0:
+            phases.append((info.snap_step, list(info.members)))
+        sim, sim_w = _sim_losses(num_steps, phases)
+        for r in (0, 1):
+            assert out[r]["losses"] == sim, f"rank {r} diverged"
+            np.testing.assert_array_equal(out[r]["w"], sim_w)
+        np.testing.assert_array_equal(out[2]["w"], sim_w)
+    finally:
+        for res in out.values():
+            res["gang"].stop()
+        telemetry.reset()
+
+    with open(ev_path) as f:
+        ev = [json.loads(ln) for ln in f if ln.strip()]
+    kinds = {e.get("event") for e in ev}
+    assert "gang_fenced" in kinds
+    assert "fencing_rejected" in kinds
+    assert "partition_healed" in kinds
+    healed = [e for e in ev if e.get("event") == "partition_healed"]
+    assert any(e.get("rank") == 2 and e.get("fenced_ms", 0) > 0
+               for e in healed)
+
+    proc = subprocess.run(
+        [sys.executable, _TRACE_REPORT, ev_path, "--validate"],
+        env=_clean_env(), capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "fencing:" in proc.stdout
+    assert "rejected stale writes:" in proc.stdout
+    assert "healed: rank 2" in proc.stdout
+    assert "heal latency:" in proc.stdout
+
+
+def test_zombie_rank_evicted_before_any_durable_write(kv_backend):
+    """Zombie containment, distilled: while this rank was out to lunch
+    a majority elsewhere committed an epoch that EXCLUDES it.  The very
+    next step_tick must raise GangEvicted from the epoch check — which
+    runs BEFORE the periodic snapshot — so no durable write of the
+    zombie's ever lands."""
+    _, make = kv_backend
+    kv = make(rank=0)
+    gang = resilience.ElasticGang(0, 1, kv=kv, peer_snap_every=1,
+                                  heartbeat_interval=0.05,
+                                  heartbeat_timeout=5.0)
+    gang.start()
+    try:
+        state = {"w": np.ones(4), "opt": 0.0}
+        gang.step_tick(0, state=state)
+        assert kv.get_json("snap/0")["step"] == 0
+        # the rest of the gang moved on without us (epoch 5, fence up)
+        other = make(rank=1)
+        other.put_json_if_epoch(
+            "epoch/current", {"epoch": 5, "members": [1], "dead": [0]},
+            5)
+        with pytest.raises(resilience.GangEvicted):
+            gang.step_tick(1, state=state)
+        # containment: the snapshot advert was never refreshed
+        assert kv.get_json("snap/0")["step"] == 0
+        # and even a direct snapshot attempt is fenced into eviction,
+        # leaving the stored advert untouched
+        with pytest.raises(resilience.GangEvicted):
+            gang.snapshot(1, state)
+        assert kv.get_json("snap/0")["step"] == 0
+    finally:
+        gang.stop()
+
+
 class _FakeGang:
     """Just enough gang surface for ScalePolicy unit tests."""
 
@@ -743,6 +1029,11 @@ def test_step_tick_steady_state_overhead(tmp_path):
                                   heartbeat_timeout=5.0)
     gang.start()
     try:
+        # the fence bookkeeping must be LIVE while the budget is
+        # measured: start() wired the committed epoch into the v8
+        # telemetry stamp, so every tick below pays the real epoch-check
+        # + stamping cost, not a fencing-disabled fast path
+        assert telemetry._GANG_EPOCH == gang.epoch
         state = {"w": np.zeros(256, dtype=np.float32)}
         for step in range(20):              # warm caches
             gang.step_tick(step, state=state)
@@ -861,7 +1152,11 @@ def test_multiproc_kill_rank_elastic_reshape(tmp_path, backend):
 def test_multiproc_dual_kill_falls_back_to_disk(tmp_path):
     """Ranks 1 AND 2 die at step 9 — rank 1's buddy (2) is gone too, so
     no common RAM snapshot can exist and the survivor must complete the
-    run from its disk manifest."""
+    run from its disk manifest.  MXTPU_QUORUM=0: one survivor of three
+    can never form a strict majority of the old epoch, and this
+    single-controller deployment explicitly opts out of the split-brain
+    guard (the documented escape hatch for worlds that shrink below
+    quorum)."""
     world, steps, snap_every, kill_step = 3, 14, 4, 9
     gang_dir = tmp_path / "gang"
     gang_dir.mkdir()
@@ -871,6 +1166,7 @@ def test_multiproc_dual_kill_falls_back_to_disk(tmp_path):
         MXTPU_HEARTBEAT_TIMEOUT="1.0",
         MXTPU_FAULT_INJECT="kill_rank:1,kill_rank:2",
         MXTPU_KILL_AT_STEP=str(kill_step),
+        MXTPU_QUORUM="0",
     )
     args = [str(tmp_path), str(steps), str(snap_every)]
     procs = {r: _spawn_rank(r, world, env, args) for r in range(world)}
@@ -887,6 +1183,110 @@ def test_multiproc_dual_kill_falls_back_to_disk(tmp_path):
     sim, sim_w = _sim_losses(steps, [(0, [0, 1, 2]), (8, [0])])
     assert losses == sim
     assert rec["w0"] == float(sim_w[0]).hex()
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("backend", ["file", "tcp"])
+def test_multiproc_partition_minority_fences_and_rejoins(tmp_path,
+                                                         backend):
+    """Real processes, both control planes: rank 2's KV path is cut at
+    its own step 6 (deferred arming — see elastic_gang_worker.py) and
+    heals 2 s later.  The majority quorum-commits the next epoch and
+    finishes; the minority prints FENCED, parks without stepping, and
+    rejoins after the heal — every rank ends at the full world
+    [0, 1, 2] with the same final step."""
+    world, steps, snap_every = 3, 30, 4
+    daemon = None
+    if backend == "file":
+        gang_dir = tmp_path / "gang"
+        gang_dir.mkdir()
+        plane = {"MXTPU_GANG_DIR": str(gang_dir)}
+    else:
+        daemon, addr = _start_kv_daemon()
+        plane = {"MXTPU_GANG_KV": "tcp", "MXTPU_GANG_ADDR": addr}
+    env = _clean_env(
+        MXTPU_HEARTBEAT_INTERVAL="0.1",
+        MXTPU_HEARTBEAT_TIMEOUT="1.0",
+        MXTPU_FAULT_INJECT="partition_split:2",
+        MXTPU_FAULT_AT_STEP="6",
+        MXTPU_PARTITION_SECS="2.0",
+        **plane,
+    )
+    args = [str(tmp_path), str(steps), str(snap_every), "100"]
+    try:
+        procs = {r: _spawn_rank(r, world, env, args)
+                 for r in range(world)}
+        outs = {r: p.communicate(timeout=180)
+                for r, p in procs.items()}
+    finally:
+        if daemon is not None:
+            daemon.terminate()
+            daemon.communicate(timeout=30)
+    for r in range(world):
+        assert procs[r].returncode == 0, outs[r]
+    for r in (0, 1):
+        results, _losses, pids = _parse_worker_output(outs[r][0])
+        rec = results[r]
+        assert len(pids) == 1
+        assert rec["final_step"] == steps
+        assert rec["fenced"] == 0, "the MAJORITY must never fence"
+        assert rec["members"] == [0, 1, 2]
+        assert rec["reshapes"] >= 2        # cut out + readmit
+    results, _losses, pids = _parse_worker_output(outs[2][0])
+    rec = results[2]
+    assert len(pids) == 1, "the fenced rank keeps its process"
+    assert "FENCED 2" in outs[2][0]
+    assert rec["fenced"] >= 1
+    assert rec["rejoined"] >= 1
+    assert rec["evictions"] == 0
+    assert rec["final_step"] == steps
+    assert rec["members"] == [0, 1, 2]
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_multiproc_pause_rank_zombie_contained_and_readmitted(tmp_path):
+    """pause_rank:2 — the rank is SIGSTOPped at step 6 for 3 s, long
+    past the heartbeat timeout; the survivors declare it dead and
+    commit the next epoch.  On SIGCONT the zombie's very next KV touch
+    must learn the committed epoch and raise GangEvicted BEFORE any
+    durable write; with MXTPU_REJOIN_ON_EVICT it then re-enters via a
+    planned admission and the full world finishes together."""
+    world, steps, snap_every = 3, 35, 4
+    gang_dir = tmp_path / "gang"
+    gang_dir.mkdir()
+    env = _clean_env(
+        MXTPU_GANG_DIR=str(gang_dir),
+        MXTPU_HEARTBEAT_INTERVAL="0.1",
+        MXTPU_HEARTBEAT_TIMEOUT="1.0",
+        MXTPU_FAULT_INJECT="pause_rank:2",
+        MXTPU_FAULT_AT_STEP="6",
+        MXTPU_PAUSE_SECS="3.0",
+        MXTPU_REJOIN_ON_EVICT="1",
+    )
+    args = [str(tmp_path), str(steps), str(snap_every), "100"]
+    procs = {r: _spawn_rank(r, world, env, args) for r in range(world)}
+    outs = {r: p.communicate(timeout=180) for r, p in procs.items()}
+    for r in range(world):
+        assert procs[r].returncode == 0, outs[r]
+    for r in (0, 1):
+        results, _losses, _pids = _parse_worker_output(outs[r][0])
+        rec = results[r]
+        assert rec["final_step"] == steps
+        assert rec["members"] == [0, 1, 2]
+        assert rec["evictions"] == 0
+    results, _losses, pids = _parse_worker_output(outs[2][0])
+    rec = results[2]
+    assert len(pids) == 1, "the zombie keeps its process"
+    assert "EVICTED 2" in outs[2][0]
+    assert rec["evictions"] == 1
+    assert rec["final_step"] == steps
+    assert rec["members"] == [0, 1, 2]
+    # containment: between SIGCONT and the eviction the zombie produced
+    # no LOSS line — its step counter froze at the pause step until the
+    # readmission rolled it to the majority's snapshot
+    assert "[resilience] injected pause_rank" in outs[2][1]
 
 
 @pytest.mark.slow

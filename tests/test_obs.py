@@ -82,7 +82,7 @@ def test_identity_from_env(tmp_path, monkeypatch):
     _emit_step(step=1)
     for rec in _read_jsonl(path):
         assert rec["rank"] == 3 and rec["world"] == 8
-        assert rec["v"] == telemetry.SCHEMA_VERSION == 7
+        assert rec["v"] == telemetry.SCHEMA_VERSION == 8
         telemetry.validate_record(rec)
 
 
@@ -106,10 +106,10 @@ def test_set_identity_merges_and_explicit_fields_win(tmp_path,
 
 def test_older_schema_versions_still_validate():
     base = {"type": "event", "event": "resume", "run": "r", "t": 1.0}
-    for v in (1, 2, 3, 4, 5, 6, 7):
+    for v in (1, 2, 3, 4, 5, 6, 7, 8):
         telemetry.validate_record(dict(base, v=v))
     with pytest.raises(ValueError, match="schema version"):
-        telemetry.validate_record(dict(base, v=8))
+        telemetry.validate_record(dict(base, v=9))
     with pytest.raises(ValueError, match="rank"):
         telemetry.validate_record(dict(base, v=3, rank="zero"))
     with pytest.raises(ValueError, match="world"):

@@ -690,3 +690,129 @@ def test_flush_inflight_emits_dropped_event(tmp_path, monkeypatch):
     assert [e["event"] for e in events] == ["inflight_save_dropped"]
     assert events[0]["step"] == 11
     assert events[0]["reason"] == "OSError"
+
+
+# -- retry_call total-elapsed budget (PR 20) ------------------------------------
+
+def test_retry_call_max_elapsed_caps_total_time():
+    """Unlike ``deadline`` (which only vetoes the next SLEEP), a slow
+    fn() burning the whole budget inside one attempt still stops at the
+    next failure — the partition-era property: KV retries hand over to
+    the fencing checks instead of retrying unboundedly."""
+    calls = []
+
+    def slow_always():
+        calls.append(1)
+        time.sleep(0.03)
+        raise OSError("partitioned")
+
+    t0 = time.monotonic()
+    with pytest.raises(mx.MXNetError, match="retry budget"):
+        retry_call(slow_always, retries=1000, backoff=0.001, jitter=0.0,
+                   max_elapsed=0.05)
+    assert time.monotonic() - t0 < 2.0
+    assert 1 < len(calls) < 10
+
+
+def test_retry_call_max_elapsed_off_by_default():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        time.sleep(0.02)
+        if len(calls) < 4:
+            raise OSError("transient")
+        return "ok"
+
+    # four slow attempts, no budget: must still succeed
+    assert retry_call(flaky, retries=10, backoff=0.001) == "ok"
+
+
+# -- partition_split / pause_rank fault sites (PR 20) ---------------------------
+
+@pytest.mark.faults
+def test_fault_spec_partition_split(fault_inject, monkeypatch):
+    fault_inject("partition_split:1,partition_split:2")
+    monkeypatch.delenv("MXTPU_PARTITION_SECS", raising=False)
+    assert resilience.partition_blocked(1)
+    assert resilience.partition_blocked(2)
+    assert not resilience.partition_blocked(0)
+    # persistent (no heal configured): still blocked on re-check
+    assert resilience.partition_blocked(1)
+
+
+@pytest.mark.faults
+def test_partition_split_heals_after_deadline(fault_inject, monkeypatch):
+    fault_inject("partition_split:1")
+    monkeypatch.setenv("MXTPU_PARTITION_SECS", "0.15")
+    assert resilience.partition_blocked(1)   # starts the heal timer
+    deadline = time.monotonic() + 5.0
+    while resilience.partition_blocked(1):
+        assert time.monotonic() < deadline, "partition never healed"
+        time.sleep(0.02)
+    assert not resilience.partition_blocked(1)   # healed stays healed
+
+
+@pytest.mark.faults
+def test_fault_spec_pause_rank_parses_one_shot(fault_inject):
+    fault_inject("pause_rank:3")
+    plan = resilience._plan()
+    assert 3 in plan.list_args["pause_rank"]
+    # one-shot per listed rank, like the other SDC sites
+    assert resilience.consume_rank_fault("pause_rank", 3)
+    assert not resilience.consume_rank_fault("pause_rank", 3)
+    assert not resilience.consume_rank_fault("pause_rank", 0)
+
+
+# -- wall-clock-jump immunity (PR 20: monotonic freshness arithmetic) -----------
+
+def test_wall_clock_jump_does_not_kill_detector(tmp_path, monkeypatch):
+    """An NTP step (hours, either direction) must not fake a partition:
+    heartbeat freshness and phi inter-arrival math run on
+    time.monotonic(), never time.time()."""
+    from mxnet_tpu import distributed
+
+    kv = distributed.FileKV(str(tmp_path))
+    hb = resilience.HeartbeatPublisher(kv, 1, interval=0.05).start()
+    det = resilience.FailureDetector(kv, 0, [0, 1], timeout=5.0,
+                                     check_interval=0.0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while not det.peer_steps() and time.monotonic() < deadline:
+            det.poll(force=True)
+            time.sleep(0.02)
+        assert det.poll(force=True) == set()
+        real_time = time.time
+        monkeypatch.setattr(time, "time",
+                            lambda: real_time() + 86400.0)
+        for _ in range(10):     # a day forward: nobody dies
+            assert det.poll(force=True) == set()
+            time.sleep(0.02)
+        monkeypatch.setattr(time, "time",
+                            lambda: real_time() - 86400.0)
+        for _ in range(10):     # two days backward: nobody dies
+            assert det.poll(force=True) == set()
+            time.sleep(0.02)
+    finally:
+        hb.stop()
+
+
+def test_wall_clock_jump_does_not_expire_leases(monkeypatch):
+    """GangKVServer lease deadlines are monotonic: a wall-clock jump
+    while a client is connected must not mass-expire its ephemeral
+    keys (heartbeats) and fake a gang-wide death."""
+    from mxnet_tpu import distributed
+
+    server = distributed.GangKVServer(lease_ttl=30.0).start()
+    kv = distributed.TcpKV(server.addr, rank=0, lease_ttl=30.0)
+    try:
+        kv.put("hb/0", b"alive")        # ephemeral -> leased
+        real_time = time.time
+        monkeypatch.setattr(time, "time",
+                            lambda: real_time() + 86400.0)
+        time.sleep(0.3)                 # a few sweeper passes
+        assert kv.get("hb/0") == b"alive"
+    finally:
+        monkeypatch.undo()
+        kv.close()
+        server.stop()

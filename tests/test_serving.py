@@ -606,3 +606,64 @@ def test_reload_from_state_enforces_attested_fingerprint():
     eng.reload_from_state(state, step=2,
                           expect_fp=integrity.fingerprint_host(state))
     telemetry.reset()
+
+
+def test_reload_skips_stale_epoch_manifest(tmp_path, monkeypatch):
+    """Epoch fence on the serving side: once a manifest from gang epoch
+    E has been served, a newer-STEP manifest stamped with an OLDER
+    epoch (a fenced trainer's leftover commit) is rejected — the
+    serving weights never roll backwards across a reshape — while a
+    same-or-newer-epoch manifest reloads normally."""
+    import json
+
+    ev_path = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("MXTPU_TELEMETRY_PATH", ev_path)
+    telemetry.reset()
+    model = _model(seed=1)
+    prompt = _prompts(1, np.random.RandomState(3))[0]
+
+    def save(step, epoch):
+        ck = checkpoint.AsyncCheckpointer(tmp_path, rank=0,
+                                          world_size=1)
+        ck.attach_gang(lambda: epoch)
+        ck.save(step, serving.state_for_serving(model))
+        ck.wait()
+        ck.close()
+
+    save(1, 2)
+    eng = serving.ServingEngine(model, batch_buckets=(1, 2))
+    rs = ReplicaServer(eng, ckpt_dir=tmp_path, poll_ms=10,
+                       max_delay_ms=1)
+    try:
+        deadline = time.monotonic() + 30
+        while rs.loaded_step != 1:
+            assert time.monotonic() < deadline, "epoch-2 reload lost"
+            rs.submit(prompt, 2).result(timeout=120)
+        assert rs._served_epoch == 2
+
+        save(2, 1)                      # newer step, OLDER epoch: stale
+        deadline = time.monotonic() + 30
+        while not telemetry.event_counts().get(
+                "serving_reload_rejected"):
+            assert time.monotonic() < deadline, \
+                "stale-epoch rejection never surfaced"
+            time.sleep(0.01)
+        time.sleep(0.2)                 # many more poll cycles
+        rs.submit(prompt, 2).result(timeout=120)
+        assert rs.loaded_step == 1, "stale-epoch manifest was served"
+        assert rs._served_epoch == 2
+
+        save(3, 2)                      # same epoch again: reloads
+        deadline = time.monotonic() + 30
+        while rs.loaded_step != 3:
+            assert time.monotonic() < deadline, "epoch-2 reload lost"
+            rs.submit(prompt, 2).result(timeout=120)
+    finally:
+        rs.close()
+    telemetry.reset()
+    with open(ev_path) as f:
+        ev = [json.loads(ln) for ln in f if ln.strip()]
+    rejected = [e for e in ev
+                if e.get("event") == "serving_reload_rejected"]
+    assert rejected and all(
+        e["reason"].startswith("stale_epoch") for e in rejected)

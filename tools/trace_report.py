@@ -147,6 +147,7 @@ def report_run(run, records, out):
             at = f" at steps {ids}" if ids else ""
             out.write(f"    {kind}: {len(group)}{at}\n")
         report_resilience(kinds, out)
+        report_fencing(kinds, out)
         report_data(kinds, out)
         report_integrity(kinds, attestations, out)
         report_fleet(kinds, requests, out)
@@ -372,6 +373,50 @@ def report_resilience(kinds, out):
     for e in kinds.get("inflight_save_dropped", ()):
         out.write(f"    inflight save dropped: step "
                   f"{e.get('step', '?')} ({e.get('reason', '?')})\n")
+
+
+def report_fencing(kinds, out):
+    """Split-brain fencing section (schema v8): which ranks fenced and
+    why, every rejected stale write by kind (kv / peer_frame /
+    checkpoint manifest), and partition heal latency.  Prints nothing
+    for runs with no fencing activity."""
+    fence_kinds = ("gang_fenced", "fencing_rejected", "ckpt_fenced",
+                   "partition_healed")
+    if not any(k in kinds for k in fence_kinds):
+        return
+    out.write("  fencing:\n")
+    fenced = kinds.get("gang_fenced", ())
+    for e in fenced:
+        out.write(f"    fenced: rank {e.get('rank', '?')} at epoch "
+                  f"{e.get('epoch', '?')} ({e.get('reason', '?')})\n")
+    rejected = kinds.get("fencing_rejected", ())
+    if rejected:
+        by_kind = {}
+        for e in rejected:
+            by_kind.setdefault(e.get("kind", "?"), []).append(e)
+        parts = ", ".join(f"{k}: {len(v)}"
+                          for k, v in sorted(by_kind.items()))
+        out.write(f"    rejected stale writes: {len(rejected)} "
+                  f"({parts})\n")
+        for e in rejected:
+            out.write(f"      {e.get('kind', '?')}: rank "
+                      f"{e.get('rank', '?')} epoch "
+                      f"{e.get('epoch', '?')} < committed "
+                      f"{e.get('committed', '?')}\n")
+    for e in kinds.get("ckpt_fenced", ()):
+        out.write(f"    ckpt commit aborted: rank {e.get('rank', '?')} "
+                  f"step {e.get('step', '?')} epoch "
+                  f"{e.get('epoch', '?')} ({e.get('reason', '?')})\n")
+    healed = kinds.get("partition_healed", ())
+    for e in healed:
+        out.write(f"    healed: rank {e.get('rank', '?')} fenced for "
+                  f"{_fmt(e.get('fenced_ms'))} ms before rejoin\n")
+    lat = [e.get("fenced_ms") for e in healed
+           if e.get("fenced_ms") is not None]
+    if lat:
+        out.write(f"    heal latency: mean {sum(lat) / len(lat):.1f} ms"
+                  f"  max {max(lat):.1f} ms over {len(lat)} "
+                  f"partition(s)\n")
 
 
 def report_data(kinds, out):
